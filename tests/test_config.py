@@ -1,0 +1,282 @@
+"""Config system tests: YAML load/parse + the full validation matrix, following
+``/root/reference/tests/config_tests.rs:16-582``."""
+
+import pytest
+
+from textblaster_tpu.config.pipeline import (
+    load_pipeline_config,
+    parse_pipeline_config,
+)
+from textblaster_tpu.errors import ConfigError, ConfigValidationError
+
+VALID_YAML = """
+pipeline:
+  - type: LanguageDetectionFilter
+    min_confidence: 0.65
+    allowed_languages: [ "dan" ]
+  - type: GopherRepetitionFilter
+    dup_line_frac: 0.3
+    top_n_grams:
+      - [2, 0.2]
+      - [3, 0.18]
+    dup_n_grams:
+      - [5, 0.15]
+  - type: GopherQualityFilter
+    min_doc_words: 50
+    max_doc_words: 100000
+    min_stop_words: 2
+    stop_words: [ "og", "er" ]
+  - type: C4QualityFilter
+    split_paragraph: true
+    remove_citations: true
+    filter_no_terminal_punct: true
+    min_num_sentences: 5
+    min_words_per_line: 3
+    max_word_length: 1000
+    filter_lorem_ipsum: true
+    filter_javascript: true
+    filter_curly_bracket: true
+    filter_policy: true
+  - type: C4BadWordsFilter
+    keep_fraction: 0.1
+    fail_on_missing_language: false
+    seed: 42
+    default_language: "en"
+  - type: FineWebQualityFilter
+    line_punct_thr: 0.12
+    line_punct_exclude_zero: false
+    short_line_thr: 0.67
+    short_line_length: 30
+    char_duplicates_ratio: 0.01
+    new_line_ratio: 0.3
+  - type: TokenCounter
+    tokenizer_name: "gpt2"
+"""
+
+
+def expect_validation_error(yaml_str, substring):
+    with pytest.raises(ConfigValidationError) as ei:
+        parse_pipeline_config(yaml_str)
+    assert substring in str(ei.value), str(ei.value)
+
+
+def test_valid_config_parses():
+    cfg = parse_pipeline_config(VALID_YAML)
+    assert [s.type for s in cfg.pipeline] == [
+        "LanguageDetectionFilter",
+        "GopherRepetitionFilter",
+        "GopherQualityFilter",
+        "C4QualityFilter",
+        "C4BadWordsFilter",
+        "FineWebQualityFilter",
+        "TokenCounter",
+    ]
+    rep = cfg.pipeline[1].params
+    assert rep.top_n_grams == [(2, 0.2), (3, 0.18)]
+    assert rep.dup_n_grams == [(5, 0.15)]
+
+
+def test_missing_file(tmp_path):
+    with pytest.raises(ConfigError) as ei:
+        load_pipeline_config(tmp_path / "nope.yaml")
+    assert "Failed to read pipeline config file" in str(ei.value)
+
+
+def test_load_from_file(tmp_path):
+    p = tmp_path / "cfg.yaml"
+    p.write_text(VALID_YAML, encoding="utf-8")
+    cfg = load_pipeline_config(p)
+    assert len(cfg.pipeline) == 7
+
+
+def test_bad_yaml_syntax():
+    with pytest.raises(ConfigError) as ei:
+        parse_pipeline_config("pipeline:\n  - type: [unclosed")
+    assert "Failed to parse pipeline config YAML" in str(ei.value)
+
+
+def test_unknown_variant():
+    with pytest.raises(ConfigError) as ei:
+        parse_pipeline_config("pipeline:\n  - type: NoSuchFilter\n    x: 1\n")
+    assert "unknown variant" in str(ei.value)
+
+
+def test_missing_required_field():
+    with pytest.raises(ConfigError) as ei:
+        parse_pipeline_config(
+            "pipeline:\n  - type: LanguageDetectionFilter\n    min_confidence: 0.5\n"
+        )
+    assert "allowed_languages" in str(ei.value)
+
+
+def test_empty_pipeline_ok():
+    cfg = parse_pipeline_config("pipeline: []\n")
+    assert cfg.pipeline == []
+
+
+def test_missing_pipeline_key():
+    with pytest.raises(ConfigError):
+        parse_pipeline_config("other: 1\n")
+
+
+class TestC4QualityValidation:
+    BASE = """
+pipeline:
+  - type: C4QualityFilter
+    split_paragraph: true
+    remove_citations: true
+    filter_no_terminal_punct: true
+    min_num_sentences: {mns}
+    min_words_per_line: {mwpl}
+    max_word_length: {mwl}
+    filter_lorem_ipsum: true
+    filter_javascript: true
+    filter_curly_bracket: true
+    filter_policy: true
+"""
+
+    def test_zero_min_num_sentences(self):
+        expect_validation_error(
+            self.BASE.format(mns=0, mwpl=3, mwl=1000),
+            "C4QualityParams: min_num_sentences must be greater than 0",
+        )
+
+    def test_zero_min_words_per_line(self):
+        expect_validation_error(
+            self.BASE.format(mns=5, mwpl=0, mwl=1000),
+            "C4QualityParams: min_words_per_line must be greater than 0",
+        )
+
+    def test_zero_max_word_length(self):
+        expect_validation_error(
+            self.BASE.format(mns=5, mwpl=3, mwl=0),
+            "C4QualityParams: max_word_length must be greater than 0",
+        )
+
+
+class TestGopherRepetitionValidation:
+    def test_fraction_out_of_range(self):
+        expect_validation_error(
+            "pipeline:\n  - type: GopherRepetitionFilter\n    dup_line_frac: 1.5\n",
+            "dup_line_frac must be between 0.0 and 1.0, got 1.5",
+        )
+
+    def test_negative_fraction(self):
+        expect_validation_error(
+            "pipeline:\n  - type: GopherRepetitionFilter\n    dup_para_frac: -0.1\n",
+            "dup_para_frac must be between 0.0 and 1.0",
+        )
+
+    def test_zero_ngram_size(self):
+        expect_validation_error(
+            "pipeline:\n  - type: GopherRepetitionFilter\n"
+            "    top_n_grams: [[0, 0.2]]\n",
+            "n-gram size in top_n_grams at index 0 must be greater than 0",
+        )
+
+    def test_bad_ngram_fraction(self):
+        expect_validation_error(
+            "pipeline:\n  - type: GopherRepetitionFilter\n"
+            "    dup_n_grams: [[2, 0.2], [3, 1.2]]\n",
+            "n-gram fraction in dup_n_grams at index 1 must be between 0.0 and 1.0",
+        )
+
+
+class TestGopherQualityValidation:
+    def test_zero_min_doc_words(self):
+        expect_validation_error(
+            "pipeline:\n  - type: GopherQualityFilter\n    min_doc_words: 0\n",
+            "min_doc_words must be greater than 0",
+        )
+
+    def test_min_greater_than_max(self):
+        expect_validation_error(
+            "pipeline:\n  - type: GopherQualityFilter\n"
+            "    min_doc_words: 100\n    max_doc_words: 50\n",
+            "min_doc_words (100) cannot be greater than max_doc_words (50)",
+        )
+
+    def test_zero_avg_word_length(self):
+        expect_validation_error(
+            "pipeline:\n  - type: GopherQualityFilter\n    min_avg_word_length: 0.0\n",
+            "min_avg_word_length must be greater than 0.0",
+        )
+
+    def test_avg_min_greater_than_max(self):
+        expect_validation_error(
+            "pipeline:\n  - type: GopherQualityFilter\n"
+            "    min_avg_word_length: 5.0\n    max_avg_word_length: 3.0\n",
+            "min_avg_word_length (5.0) cannot be greater than max_avg_word_length (3.0)",
+        )
+
+    def test_negative_ratio(self):
+        expect_validation_error(
+            "pipeline:\n  - type: GopherQualityFilter\n"
+            "    max_symbol_word_ratio: -0.5\n",
+            "max_symbol_word_ratio must be non-negative",
+        )
+
+
+class TestC4BadWordsValidation:
+    def test_keep_fraction_out_of_range(self):
+        expect_validation_error(
+            "pipeline:\n  - type: C4BadWordsFilter\n    keep_fraction: 1.5\n"
+            "    fail_on_missing_language: true\n    default_language: en\n",
+            "keep_fraction must be between 0.0 and 1.0",
+        )
+
+    def test_empty_default_language(self):
+        expect_validation_error(
+            "pipeline:\n  - type: C4BadWordsFilter\n    keep_fraction: 0.5\n"
+            "    fail_on_missing_language: true\n    default_language: \"\"\n",
+            "default_language cannot be empty",
+        )
+
+
+class TestLanguageDetectionValidation:
+    def test_confidence_out_of_range(self):
+        expect_validation_error(
+            "pipeline:\n  - type: LanguageDetectionFilter\n"
+            "    min_confidence: 1.5\n    allowed_languages: [dan]\n",
+            "min_confidence must be between 0.0 and 1.0, got 1.5",
+        )
+
+    def test_empty_allowed_languages(self):
+        expect_validation_error(
+            "pipeline:\n  - type: LanguageDetectionFilter\n"
+            "    min_confidence: 0.5\n    allowed_languages: []\n",
+            "allowed_languages cannot be empty",
+        )
+
+
+class TestFineWebValidation:
+    BASE = """
+pipeline:
+  - type: FineWebQualityFilter
+    line_punct_thr: {lpt}
+    line_punct_exclude_zero: false
+    short_line_thr: 0.67
+    short_line_length: {sll}
+    char_duplicates_ratio: 0.01
+    new_line_ratio: 0.3
+"""
+
+    def test_threshold_out_of_range(self):
+        expect_validation_error(
+            self.BASE.format(lpt=1.3, sll=30),
+            "line_punct_thr must be between 0.0 and 1.0, got 1.3",
+        )
+
+    def test_zero_short_line_length(self):
+        expect_validation_error(
+            self.BASE.format(lpt=0.12, sll=0),
+            "short_line_length must be greater than 0",
+        )
+
+
+class TestTokenCounterValidation:
+    def test_empty_tokenizer_name(self):
+        expect_validation_error(
+            'pipeline:\n  - type: TokenCounter\n    tokenizer_name: ""\n',
+            "tokenizer_name cannot be empty",
+        )

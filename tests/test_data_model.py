@@ -1,0 +1,75 @@
+"""Data model wire-format tests: serde-compatible JSON roundtrips
+(``/root/reference/src/data_model.rs:5-34``)."""
+
+import json
+from datetime import date, datetime
+
+from textblaster_tpu.data_model import ProcessingOutcome, TextDocument
+
+
+def full_doc():
+    return TextDocument(
+        id="doc-1",
+        content="Hello world.",
+        source="file.parquet",
+        added=date(2024, 1, 31),
+        created=(datetime(2024, 1, 1, 12, 0, 0), datetime(2024, 1, 2, 13, 30, 45)),
+        metadata={"key": "value", "language": "da"},
+    )
+
+
+def test_document_json_roundtrip():
+    d = full_doc()
+    j = d.to_json()
+    back = TextDocument.from_json(j)
+    assert back.id == d.id
+    assert back.content == d.content
+    assert back.source == d.source
+    assert back.added == d.added
+    assert back.created == d.created
+    assert back.metadata == d.metadata
+
+
+def test_document_serde_wire_format():
+    payload = json.loads(full_doc().to_json())
+    # chrono NaiveDate serializes as "YYYY-MM-DD", NaiveDateTime ISO-8601.
+    assert payload["added"] == "2024-01-31"
+    assert payload["created"] == ["2024-01-01T12:00:00", "2024-01-02T13:30:45"]
+    assert payload["metadata"] == {"key": "value", "language": "da"}
+
+
+def test_document_optional_fields_null():
+    d = TextDocument(id="x", content="c", source="s")
+    payload = json.loads(d.to_json())
+    assert payload["added"] is None
+    assert payload["created"] is None
+    back = TextDocument.from_json(d.to_json())
+    assert back.added is None and back.created is None
+
+
+def test_outcome_success_roundtrip():
+    o = ProcessingOutcome.success(full_doc())
+    payload = json.loads(o.to_json())
+    assert "Success" in payload
+    back = ProcessingOutcome.from_json(o.to_json())
+    assert back.kind == ProcessingOutcome.SUCCESS
+    assert back.document.id == "doc-1"
+
+
+def test_outcome_filtered_roundtrip():
+    o = ProcessingOutcome.filtered(full_doc(), "some; reasons")
+    payload = json.loads(o.to_json())
+    assert payload["Filtered"]["reason"] == "some; reasons"
+    back = ProcessingOutcome.from_json(o.to_json())
+    assert back.kind == ProcessingOutcome.FILTERED
+    assert back.reason == "some; reasons"
+
+
+def test_outcome_error_roundtrip():
+    o = ProcessingOutcome.error(full_doc(), "boom", "worker-1")
+    payload = json.loads(o.to_json())
+    assert payload["Error"]["error_message"] == "boom"
+    assert payload["Error"]["worker_id"] == "worker-1"
+    back = ProcessingOutcome.from_json(o.to_json())
+    assert back.kind == ProcessingOutcome.ERROR
+    assert back.error_message == "boom"

@@ -1,0 +1,103 @@
+"""Executor tests, following ``/root/reference/tests/executor_test.rs`` —
+mock steps with injectable behavior, ordering, short-circuit, batch."""
+
+import pytest
+
+from textblaster_tpu.data_model import TextDocument
+from textblaster_tpu.errors import DocumentFiltered, StepError, UnexpectedError
+from textblaster_tpu.executor import PipelineExecutor, ProcessingStep
+
+
+class MockStep(ProcessingStep):
+    def __init__(self, name, fn=None, fail=False):
+        self.name = name
+        self.fn = fn
+        self.fail = fail
+        self.calls = 0
+
+    def process(self, document):
+        self.calls += 1
+        if self.fail:
+            raise UnexpectedError(f"{self.name} failed")
+        if self.fn:
+            return self.fn(document)
+        return document
+
+
+class FilteringStep(ProcessingStep):
+    name = "FilteringStep"
+
+    def process(self, document):
+        document.metadata["filtered_by"] = self.name
+        raise DocumentFiltered(document, "test filter reason")
+
+
+class SmartErrorStep(ProcessingStep):
+    """Fails only for a specific doc id (executor_test.rs:352-376)."""
+
+    name = "SmartErrorStep"
+
+    def __init__(self, bad_id):
+        self.bad_id = bad_id
+
+    def process(self, document):
+        if document.id == self.bad_id:
+            raise UnexpectedError("doc-specific failure")
+        return document
+
+
+def doc(id="d1", content="content"):
+    return TextDocument(id=id, content=content, source="s")
+
+
+def test_empty_pipeline_passes_through():
+    ex = PipelineExecutor([])
+    d = doc()
+    assert ex.run_single(d) is d
+
+
+def test_steps_run_in_order():
+    order = []
+
+    def mk(name):
+        def fn(d):
+            order.append(name)
+            d.metadata[name] = "ran"
+            return d
+
+        return MockStep(name, fn=fn)
+
+    ex = PipelineExecutor([mk("first"), mk("second"), mk("third")])
+    out = ex.run_single(doc())
+    assert order == ["first", "second", "third"]
+    assert set(out.metadata) == {"first", "second", "third"}
+
+
+def test_error_short_circuits():
+    s1 = MockStep("ok1")
+    s2 = MockStep("boom", fail=True)
+    s3 = MockStep("never")
+    ex = PipelineExecutor([s1, s2, s3])
+    with pytest.raises(StepError) as ei:
+        ex.run_single(doc())
+    assert ei.value.step_name == "boom"
+    assert s3.calls == 0
+
+
+def test_filtered_wrapped_in_step_error():
+    ex = PipelineExecutor([FilteringStep()])
+    with pytest.raises(StepError) as ei:
+        ex.run_single(doc())
+    inner = ei.value.filtered()
+    assert inner is not None
+    assert inner.reason == "test filter reason"
+    assert inner.document.metadata["filtered_by"] == "FilteringStep"
+
+
+def test_batch_mixed_results_input_order():
+    ex = PipelineExecutor([SmartErrorStep(bad_id="bad")])
+    docs = [doc("good1"), doc("bad"), doc("good2")]
+    results = ex.run_batch(docs)
+    assert isinstance(results[0], TextDocument) and results[0].id == "good1"
+    assert isinstance(results[1], StepError)
+    assert isinstance(results[2], TextDocument) and results[2].id == "good2"

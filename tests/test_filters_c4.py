@@ -1,0 +1,234 @@
+"""C4QualityFilter + C4BadWordsFilter tests ported from
+``/root/reference/src/pipeline/filters/c4_filters.rs:554-1176``."""
+
+import pytest
+
+from textblaster_tpu.data_model import TextDocument
+from textblaster_tpu.errors import DocumentFiltered
+from textblaster_tpu.filters import C4BadWordsFilter, C4QualityFilter
+from textblaster_tpu.filters.c4_badwords import C4BadWordsParams
+
+
+def doc(content, id="t", metadata=None):
+    return TextDocument(
+        id=id, source="test_source", content=content, metadata=metadata or {}
+    )
+
+
+def default_filter():
+    return C4QualityFilter(
+        split_paragraph=True,
+        remove_citations=True,
+        filter_no_terminal_punct=True,
+        min_num_sentences=5,
+        min_words_per_line=3,
+        max_word_length=1000,
+        filter_lorem_ipsum=True,
+        filter_javascript=True,
+        filter_curly_bracket=True,
+        filter_policy=True,
+    )
+
+
+def fail_reason(filt, d):
+    with pytest.raises(DocumentFiltered) as ei:
+        filt.process(d)
+    return ei.value.reason
+
+
+GOOD_TAIL = (
+    "Another good line. This is the fourth sentence. And the fifth sentence. "
+    "Here is the sixth."
+)
+
+
+class TestC4Quality:
+    def test_document_passes(self):
+        content = (
+            "This is the first sentence. This is the second sentence. "
+            "This is the third sentence. This is the fourth sentence. "
+            "This is the fifth sentence."
+        )
+        out = default_filter().process(doc(content))
+        assert out.metadata["c4_filter_status"] == "passed"
+        assert out.content.strip() == content.strip()
+
+    def test_too_few_sentences(self):
+        reason = fail_reason(
+            default_filter(),
+            doc("One sentence. Two sentences. Three sentences. Four sentences."),
+        )
+        assert "too_few_sentences (found 4, required 5)" in reason
+
+    def test_line_too_few_words(self):
+        content = f"This line is fine.\nTwo words.\n{GOOD_TAIL}"
+        out = default_filter().process(doc(content))
+        assert out.content.strip() == f"This line is fine.\n{GOOD_TAIL}"
+        assert out.metadata["c4_filter_status"] == "passed"
+
+    def test_line_missing_terminal_punctuation(self):
+        content = (
+            "This line is fine.\nThis one is not\nAnd this is okay. "
+            "Here is another sentence. And a fifth one. This is the sixth sentence."
+        )
+        out = default_filter().process(doc(content))
+        assert out.content.strip() == (
+            "This line is fine.\nAnd this is okay. Here is another sentence. "
+            "And a fifth one. This is the sixth sentence."
+        )
+
+    def test_line_ends_with_ellipsis(self):
+        content = (
+            f"This line is fine.\nThis one ends with ellipsis...\nAnd this is okay. "
+            "This is the fourth sentence. And the fifth sentence. Here is the sixth."
+        )
+        out = default_filter().process(doc(content))
+        assert out.content.strip() == (
+            "This line is fine.\nAnd this is okay. This is the fourth sentence. "
+            "And the fifth sentence. Here is the sixth."
+        )
+
+    def test_word_too_long(self):
+        long_word = "a" * 1001
+        content = (
+            f"This line is fine.\nA line with a verylongword {long_word}.\n{GOOD_TAIL}"
+        )
+        out = default_filter().process(doc(content))
+        assert out.content.strip() == f"This line is fine.\n{GOOD_TAIL}"
+
+    def test_filter_lorem_ipsum(self):
+        reason = fail_reason(
+            default_filter(),
+            doc("This is fine. Lorem ipsum dolor sit amet. This is also fine."),
+        )
+        assert "lorem_ipsum" in reason
+
+    def test_filter_javascript(self):
+        content = f"This is fine.\nSome javascript code here.\n{GOOD_TAIL}"
+        out = default_filter().process(doc(content))
+        assert out.content.strip() == f"This is fine.\n{GOOD_TAIL}"
+
+    def test_filter_curly_bracket(self):
+        reason = fail_reason(
+            default_filter(),
+            doc("This is fine.\nSome code block {}.\nAnother good line."),
+        )
+        assert "curly_bracket" in reason
+
+    def test_filter_policy(self):
+        content = f"This is fine.\nRead our privacy policy.\n{GOOD_TAIL}"
+        out = default_filter().process(doc(content))
+        assert out.content.strip() == f"This is fine.\n{GOOD_TAIL}"
+
+    def test_remove_citations(self):
+        content = (
+            "This is text [1]. Another sentence [2, 3]. Final text [45]. "
+            "Here is the fourth sentence. And the fifth sentence. "
+            "This is the sixth sentence."
+        )
+        out = default_filter().process(doc(content))
+        assert out.content.strip() == (
+            "This is text . Another sentence . Final text . "
+            "Here is the fourth sentence. And the fifth sentence. "
+            "This is the sixth sentence."
+        )
+
+    def test_empty_document_content(self):
+        assert "too_few_sentences (found 0, required 5)" in fail_reason(
+            default_filter(), doc("")
+        )
+
+    def test_content_just_spaces(self):
+        assert "too_few_sentences (found 0, required 5)" in fail_reason(
+            default_filter(), doc("   \n   ")
+        )
+
+    def test_line_stats_in_metadata_on_filter(self):
+        # Dropping lines leaves too few sentences -> line stats stamped.
+        f = default_filter()
+        with pytest.raises(DocumentFiltered) as ei:
+            f.process(doc("Two words.\nAlso short.\nNo terminal punct here"))
+        md = ei.value.document.metadata
+        assert md["c4_filter_status"] == "filtered"
+        assert md.get("line-filter-too_few_words") == "2"
+        assert md.get("line-filter-no_terminal_punc") == "1"
+
+
+class TestC4BadWords:
+    def params(self, tmp_path, **overrides):
+        kwargs = dict(
+            keep_fraction=0.0,
+            fail_on_missing_language=True,
+            seed=42,
+            default_language="en",
+            cache_base_path=tmp_path,
+        )
+        kwargs.update(overrides)
+        return C4BadWordsParams(**kwargs)
+
+    def write_list(self, tmp_path, lang, words):
+        (tmp_path / lang).write_text("\n".join(words), encoding="utf-8")
+
+    def test_badwords_filtered(self, tmp_path):
+        self.write_list(tmp_path, "en", ["badword", "nasty"])
+        f = C4BadWordsFilter(self.params(tmp_path))
+        reason = None
+        with pytest.raises(DocumentFiltered) as ei:
+            f.process(doc("this text contains a badword here"))
+        reason = ei.value.reason
+        assert reason == "document_removed_with_badwords"
+        assert (
+            ei.value.document.metadata["c4_badwords_filter_status"] == "filtered"
+        )
+
+    def test_clean_doc_passes(self, tmp_path):
+        self.write_list(tmp_path, "en", ["badword"])
+        f = C4BadWordsFilter(self.params(tmp_path))
+        out = f.process(doc("perfectly clean text here"))
+        assert out.metadata["c4_badwords_filter_status"] == "passed"
+
+    def test_word_boundary_anchoring(self, tmp_path):
+        # Non-CJK lists match whole words only (c4_filters.rs:437-439).
+        self.write_list(tmp_path, "en", ["ass"])
+        f = C4BadWordsFilter(self.params(tmp_path))
+        out = f.process(doc("the assembly passed the assessment"))
+        assert out.metadata["c4_badwords_filter_status"] == "passed"
+        with pytest.raises(DocumentFiltered):
+            f.process(doc("what an ass he is"))
+
+    def test_case_insensitive(self, tmp_path):
+        self.write_list(tmp_path, "en", ["badword"])
+        f = C4BadWordsFilter(self.params(tmp_path))
+        with pytest.raises(DocumentFiltered):
+            f.process(doc("this contains BADWORD loudly"))
+
+    def test_missing_language_fails(self, tmp_path):
+        f = C4BadWordsFilter(self.params(tmp_path))
+        with pytest.raises(DocumentFiltered) as ei:
+            f.process(doc("anything", metadata={"language": "zz"}))
+        assert "There is no badwords list available for 'zz'" in ei.value.reason
+
+    def test_missing_language_pass_when_not_failing(self, tmp_path):
+        f = C4BadWordsFilter(self.params(tmp_path, fail_on_missing_language=False))
+        out = f.process(doc("anything", metadata={"language": "zz"}))
+        assert out.metadata["c4_badwords_filter_status"] == "passed_no_regex"
+
+    def test_language_from_metadata(self, tmp_path):
+        self.write_list(tmp_path, "da", ["grimtord"])
+        f = C4BadWordsFilter(self.params(tmp_path))
+        with pytest.raises(DocumentFiltered):
+            f.process(doc("dette er et grimtord her", metadata={"language": "da"}))
+
+    def test_keep_fraction_one_keeps(self, tmp_path):
+        self.write_list(tmp_path, "en", ["badword"])
+        f = C4BadWordsFilter(self.params(tmp_path, keep_fraction=1.0))
+        out = f.process(doc("this has a badword in it"))
+        assert (
+            out.metadata["c4_badwords_filter_status"] == "passed_kept_by_fraction"
+        )
+
+    def test_empty_list_acts_as_missing(self, tmp_path):
+        self.write_list(tmp_path, "en", [])
+        f = C4BadWordsFilter(self.params(tmp_path))
+        out = f.process(doc("anything at all"))
+        assert out.metadata["c4_badwords_filter_status"] == "passed_no_regex"

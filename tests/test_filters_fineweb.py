@@ -1,0 +1,164 @@
+"""FineWebQualityFilter tests ported from
+``/root/reference/src/pipeline/filters/fineweb_quality.rs:229-604``."""
+
+import pytest
+
+from textblaster_tpu.data_model import TextDocument
+from textblaster_tpu.errors import DocumentFiltered
+from textblaster_tpu.filters import FineWebQualityFilter
+
+
+def default_filter(**overrides):
+    kwargs = dict(
+        line_punct_thr=0.12,
+        line_punct_exclude_zero=False,
+        short_line_thr=0.67,
+        short_line_length=30,
+        char_duplicates_ratio=0.95,
+        new_line_ratio=0.3,
+    )
+    kwargs.update(overrides)
+    return FineWebQualityFilter(**kwargs)
+
+
+def doc(content, id="t"):
+    return TextDocument(id=id, source="test_source", content=content)
+
+
+def fail_reason(filt, d):
+    with pytest.raises(DocumentFiltered) as ei:
+        filt.process(d)
+    return ei.value.reason
+
+
+def test_empty_document_content():
+    assert fail_reason(default_filter(), doc("")) == "empty"
+
+
+def test_whitespace_only_document_content():
+    assert fail_reason(default_filter(), doc("   \n\t   \n ")) == "empty"
+
+
+def test_empty_metadata_quirk():
+    # Metadata says "empty document" while the outcome reason is "empty"
+    # (fineweb_quality.rs:79-89).
+    f = default_filter()
+    d = doc("")
+    with pytest.raises(DocumentFiltered) as ei:
+        f.process(d)
+    assert ei.value.document.metadata["fineweb_filter_reason"] == "empty document"
+
+
+def test_line_punct_ratio_fail_low_ratio():
+    content = "\n".join(
+        ["Line one", "Line two", "Line three", "Line four", "Line five",
+         "Line six", "Line seven", "Line eight", "Line nine", "Line ten."]
+    )  # 1/10 = 0.1
+    reason = fail_reason(default_filter(), doc(content))
+    assert reason.startswith("line_punct_ratio: 0.1000 < threshold 0.1200")
+
+
+def test_line_punct_ratio_pass():
+    f = default_filter(short_line_thr=1.0)
+    content = (
+        "Line one is long enough and ends with a period.\n"
+        "Line two is also long enough and ends with a question mark?\n"
+        "Line three is also very long indeed and ends with an exclamation mark!"
+    )
+    f.process(doc(content))
+
+
+def test_line_punct_ratio_zero_exclude_zero_true():
+    f = default_filter(line_punct_exclude_zero=True, short_line_thr=1.0)
+    content = (
+        "Looooooooong line one, no punctuation here\n"
+        "Looooooooong line two, also no punctuation\n"
+        "Looooooooong line three, definitely no punctuation"
+    )
+    f.process(doc(content))
+
+
+def test_line_punct_ratio_zero_exclude_zero_false():
+    reason = fail_reason(default_filter(), doc("Line one\nLine two\nLine three"))
+    assert reason.startswith("line_punct_ratio: 0.0000 < threshold 0.1200")
+
+
+def test_short_line_ratio_fail():
+    content = (
+        "Short line.\nThis is another short one.\nWay too short.\n"
+        "This line is definitely longer than thirty characters to provide some balance."
+    )  # 3/4 = 0.75 > 0.67
+    reason = fail_reason(default_filter(), doc(content))
+    assert reason.startswith("short_line_ratio: 0.7500 > threshold 0.6700")
+
+
+def test_short_line_ratio_pass():
+    content = (
+        "This line is adequately long and should pass.\n"
+        "So is this one, it meets the criteria perfectly.\n"
+        "And another one just to be sure it's fine."
+    )
+    default_filter().process(doc(content))
+
+
+def test_char_dup_ratio_pass_no_duplicates():
+    f = default_filter(line_punct_thr=0.0, short_line_thr=1.0, new_line_ratio=1.0)
+    f.process(doc("abcdefghijklmnopqrstuvwxyz.\n1234567890."))
+
+
+def test_char_dup_ratio_all_same_fail():
+    f = default_filter(
+        line_punct_thr=0.0,
+        short_line_thr=1.0,
+        new_line_ratio=1.0,
+        char_duplicates_ratio=0.66,
+    )
+    # 2 duplicate "Hello World" lines x 11 bytes / 33 chars = 0.6667.
+    reason = fail_reason(f, doc("Hello World\nHello World\nHello World"))
+    assert reason.startswith("char_dup_ratio: 0.6667 > threshold 0.6600")
+
+
+def test_new_line_ratio_fail():
+    f = default_filter(line_punct_thr=0.0, short_line_thr=1.0)
+    reason = fail_reason(f, doc("word.\nword.\nword.\nword.\nword."))
+    assert reason.startswith("list_ratio: 0.8000 > threshold 0.3000")
+
+
+def test_new_line_ratio_pass():
+    default_filter().process(
+        doc(
+            "Many words on a single line with no newlines effectively. "
+            "This should pass easily."
+        )
+    )
+    default_filter().process(
+        doc(
+            "Word one is long enough and ends with a period.\n"
+            "Word two is also quite long and ends with a period.\n"
+            "Word three is suitably lengthy and ends with a period.\n"
+            "Word four and five and six are here and it ends with a period."
+        )
+    )
+
+
+def test_new_line_ratio_no_words_fail():
+    # "empty" check takes precedence (fineweb_quality.rs:531-543).
+    assert fail_reason(default_filter(), doc("\n\n\n")) == "empty"
+
+
+def test_no_words_no_newlines_short_line_fails_first():
+    reason = fail_reason(default_filter(), doc("... --- !!!"))
+    assert reason.startswith("short_line_ratio: 1.0000 > threshold 0.6700")
+
+
+def test_passing_document():
+    content = (
+        "This is a good line that ends with a period.\n"
+        "Another good line also ends with a question mark?\n"
+        "Short lines are not too frequent here, which is great!\n"
+        "Character duplication is hopefully not too high in this example text.\n"
+        "And the ratio of newlines to words should be reasonable as well."
+    )
+    out = default_filter().process(doc(content))
+    # Success path stamps no fineweb metadata (fineweb_quality.rs:225).
+    assert "fineweb_filter_status" not in out.metadata

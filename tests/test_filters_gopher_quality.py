@@ -1,0 +1,141 @@
+"""GopherQualityFilter tests ported from
+``/root/reference/src/pipeline/filters/gopher_quality.rs:321-830``."""
+
+import pytest
+
+from textblaster_tpu.data_model import TextDocument
+from textblaster_tpu.errors import DocumentFiltered
+from textblaster_tpu.filters import GopherQualityFilter
+
+
+def doc(content, id="t"):
+    return TextDocument(id=id, source="gopher_test_source", content=content)
+
+
+def fail_reason(filt, d):
+    with pytest.raises(DocumentFiltered) as ei:
+        filt.process(d)
+    return ei.value.reason
+
+
+def test_doc_passes_permissive_filter():
+    f = GopherQualityFilter()
+    out = f.process(doc("This is a perfectly normal document with the and of words."))
+    assert out.metadata["gopher_quality_filter_status"] == "passed"
+
+
+def test_min_doc_words():
+    f = GopherQualityFilter(min_doc_words=3)
+    assert f.process(doc("Hello world test . !")).metadata
+    assert "gopher_short_doc (2 non-symbol words, required 3)" in fail_reason(
+        f, doc("Hello world . !")
+    )
+    assert "gopher_short_doc (0 non-symbol words, required 3)" in fail_reason(
+        f, doc(". ! ?")
+    )
+
+
+def test_max_doc_words():
+    f = GopherQualityFilter(max_doc_words=3)
+    f.process(doc("One two three ."))
+    assert "gopher_long_doc (4 non-symbol words, max 3)" in fail_reason(
+        f, doc("One two three four .")
+    )
+
+
+def test_avg_word_length():
+    f = GopherQualityFilter(min_avg_word_length=3.0, max_avg_word_length=5.0)
+    f.process(doc("cat words test ."))
+    assert "gopher_below_avg_threshold (avg len 1.50, required 3.00)" in fail_reason(
+        f, doc("a it .")
+    )
+    assert "gopher_above_avg_threshold (avg len 7.00, max 5.00)" in fail_reason(
+        f, doc("testing another .")
+    )
+    assert (
+        "gopher_below_avg_threshold (avg len 0.00, required 3.00 - 0 non-symbol words)"
+        in fail_reason(f, doc(". ! ."))
+    )
+
+
+def test_max_symbol_word_ratio_hashes():
+    f = GopherQualityFilter(max_symbol_word_ratio=0.1)
+    f.process(doc("word1 word2 # word3 word4 word5 word6 word7 word8 word9 word10"))
+    assert "gopher_too_many_hashes (ratio 0.25, max 0.10)" in fail_reason(
+        f, doc("word1 # word2 # word3 word4 word5 word6 word7 word8")
+    )
+    f.process(doc(""))  # empty passes hash ratio
+    assert "gopher_too_many_hashes (ratio 1.00, max 0.10)" in fail_reason(f, doc("#"))
+
+
+def test_max_symbol_word_ratio_ellipsis():
+    f = GopherQualityFilter(max_symbol_word_ratio=0.1)
+    f.process(doc("word1 word2 ... word3 word4 word5 word6 word7 word8 word9 word10"))
+    assert "gopher_too_many_ellipsis_units (ratio 0.25, max 0.10)" in fail_reason(
+        f, doc("word1 ... word2 … word3 word4 word5 word6 word7 word8")
+    )
+
+
+def test_max_bullet_lines_ratio():
+    f = GopherQualityFilter(max_bullet_lines_ratio=0.5)
+    f.process(doc("- item 1\n- item 2\nnormal line\nanother normal line"))
+    assert "gopher_too_many_bullets (ratio 0.75, max 0.50)" in fail_reason(
+        f, doc("- item 1\n- item 2\n- item 3\nnormal line")
+    )
+    f.process(doc(""))  # 0 lines -> 0/1 -> pass
+    assert "gopher_too_many_bullets (ratio 1.00, max 0.50)" in fail_reason(
+        f, doc("- all bullets")
+    )
+
+
+def test_max_ellipsis_lines_ratio():
+    f = GopherQualityFilter(max_ellipsis_lines_ratio=0.5)
+    f.process(doc("Line one...\nLine two…\nNormal line\nAnother normal"))
+    assert "gopher_too_many_end_ellipsis_lines (ratio 0.75, max 0.50)" in fail_reason(
+        f, doc("Line one...\nLine two…\nLine three...\nNormal line")
+    )
+
+
+def test_alphabetic_word_ratio():
+    f = GopherQualityFilter(max_non_alpha_words_ratio=0.5)
+    f.process(doc("word 123 word !!!"))
+    assert (
+        "gopher_below_alpha_threshold (alpha ratio 0.33, required min 0.50)"
+        in fail_reason(f, doc("word 123 456 !!!"))
+    )
+    assert (
+        "gopher_below_alpha_threshold (alpha ratio 0.00, required min 0.50)"
+        in fail_reason(f, doc("123 456 789 !!!"))
+    )
+    assert (
+        "gopher_below_alpha_threshold (alpha ratio 0.00, required min 0.50)"
+        in fail_reason(f, doc(""))
+    )
+
+
+def test_stop_word_presence():
+    f = GopherQualityFilter(min_stop_words=2)
+    f.process(doc("the quick brown fox and the lazy dog"))
+    assert "gopher_too_few_stop_words (found 0, required 2)" in fail_reason(
+        f, doc("a quick brown fox is lazy")
+    )
+
+    f_custom = GopherQualityFilter(min_stop_words=1, stop_words=["custom", "words"])
+    f_custom.process(doc("this is a custom test with other words"))
+    assert "gopher_too_few_stop_words (found 0, required 1)" in fail_reason(
+        f_custom, doc("this is a regular sentence")
+    )
+
+    f_zero = GopherQualityFilter(min_stop_words=0)
+    f_zero.process(doc("no stop words here"))
+    f_none = GopherQualityFilter(min_stop_words=None)
+    f_none.process(doc("no stop words here"))
+
+
+def test_metadata_stamped_on_filter():
+    f = GopherQualityFilter(min_doc_words=100)
+    with pytest.raises(DocumentFiltered) as ei:
+        f.process(doc("short text ."))
+    d = ei.value.document
+    assert d.metadata["gopher_quality_filter_status"] == "filtered"
+    assert "gopher_short_doc" in d.metadata["gopher_quality_filter_reasons"]

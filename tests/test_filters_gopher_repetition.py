@@ -1,0 +1,90 @@
+"""GopherRepetitionFilter tests, following
+``/root/reference/src/pipeline/filters/gopher_rep.rs:223-643``."""
+
+import pytest
+
+from textblaster_tpu.data_model import TextDocument
+from textblaster_tpu.errors import DocumentFiltered
+from textblaster_tpu.filters import GopherRepetitionFilter
+
+
+def doc(content, id="t"):
+    return TextDocument(id=id, source="s", content=content)
+
+
+def fail_reason(filt, d):
+    with pytest.raises(DocumentFiltered) as ei:
+        filt.process(d)
+    return ei.value.reason
+
+
+def test_empty_content_filtered():
+    f = GopherRepetitionFilter()
+    assert fail_reason(f, doc("")) == "skipping empty content"
+    assert fail_reason(f, doc("   \n  ")) == "skipping empty content"
+
+
+def test_passes_with_no_thresholds():
+    out = GopherRepetitionFilter().process(doc("Unique one.\n\nUnique two."))
+    assert out.metadata["gopher_repetition_filter_status"] == "passed"
+
+
+def test_dup_para_frac():
+    # 3 paragraphs, 1 duplicate -> ratio 0.33 (gopher_rep.rs:445).
+    f = GopherRepetitionFilter(dup_para_frac=0.30)
+    content = "Same paragraph here.\n\nSame paragraph here.\n\nDifferent paragraph."
+    assert "dup_para_frac (ratio 0.33, max 0.30)" in fail_reason(f, doc(content))
+    f.process(doc("One paragraph.\n\nAnother paragraph.\n\nThird paragraph."))
+
+
+def test_dup_para_char_frac():
+    f = GopherRepetitionFilter(dup_para_char_frac=0.2)
+    content = "Same paragraph here.\n\nSame paragraph here.\n\nShort."
+    assert "dup_para_char_frac" in fail_reason(f, doc(content))
+
+
+def test_dup_line_frac():
+    f = GopherRepetitionFilter(dup_line_frac=0.3)
+    content = "same line\nsame line\nother line"
+    assert "dup_line_frac (ratio 0.33, max 0.30)" in fail_reason(f, doc(content))
+
+
+def test_dup_line_char_frac():
+    f = GopherRepetitionFilter(dup_line_char_frac=0.2)
+    content = "duplicate line text\nduplicate line text\nx"
+    reason = fail_reason(f, doc(content))
+    assert "dup_line_char_frac" in reason
+    assert "max 0.20" in reason
+
+
+def test_top_n_gram():
+    f = GopherRepetitionFilter(top_n_grams=[(2, 0.2)])
+    # "spam ham" repeated dominates the char mass.
+    content = "spam ham spam ham spam ham spam ham"
+    assert "top_2_gram" in fail_reason(f, doc(content))
+    f2 = GopherRepetitionFilter(top_n_grams=[(2, 0.95)])
+    f2.process(doc(content))
+
+
+def test_dup_n_grams():
+    f = GopherRepetitionFilter(dup_n_grams=[(2, 0.2)])
+    content = "alpha beta alpha beta alpha beta alpha beta"
+    assert "duplicated_2_n_grams" in fail_reason(f, doc(content))
+
+
+def test_multiple_reasons_accumulate():
+    f = GopherRepetitionFilter(dup_line_frac=0.1, dup_line_char_frac=0.1)
+    content = "same line\nsame line\nsame line"
+    reason = fail_reason(f, doc(content))
+    assert "dup_line_frac" in reason
+    assert "dup_line_char_frac" in reason
+    assert "; " in reason
+
+
+def test_metadata_on_filtered():
+    f = GopherRepetitionFilter(dup_line_frac=0.1)
+    with pytest.raises(DocumentFiltered) as ei:
+        f.process(doc("x\nx\nx"))
+    md = ei.value.document.metadata
+    assert md["gopher_repetition_filter_status"] == "filtered"
+    assert "dup_line_frac" in md["gopher_repetition_filter_reasons"]

@@ -1,0 +1,75 @@
+"""LanguageDetectionFilter tests, following
+``/root/reference/src/pipeline/filters/language_filter.rs:96-227``."""
+
+import pytest
+
+from textblaster_tpu.data_model import TextDocument
+from textblaster_tpu.errors import DocumentFiltered
+from textblaster_tpu.filters import LanguageDetectionFilter
+
+ENGLISH_TEXT = (
+    "This is clearly an English sentence about the weather and the people "
+    "who live in the town near the river."
+)
+DANISH_TEXT = (
+    "Jeg kan godt lide at spise æbler og drikke kaffe om morgenen, når solen "
+    "står op over København og fuglene synger."
+)
+
+
+def doc(content, id="t"):
+    return TextDocument(id=id, source="s", content=content)
+
+
+def test_allowed_language_passes():
+    f = LanguageDetectionFilter(min_confidence=0.5, allowed_languages=["eng"])
+    out = f.process(doc(ENGLISH_TEXT))
+    assert out.metadata["Detected language"] == "English"
+    assert float(out.metadata["Detected language confidence"]) >= 0.5
+
+
+def test_disallowed_language_filtered_with_metadata():
+    # Detected language metadata is stamped even on the filtered path
+    # (language_filter.rs:51-57, quirk #11).
+    f = LanguageDetectionFilter(min_confidence=0.5, allowed_languages=["eng"])
+    with pytest.raises(DocumentFiltered) as ei:
+        f.process(doc(DANISH_TEXT))
+    assert 'Document is not any of the following languages: "eng"' in ei.value.reason
+    assert ei.value.document.metadata["Detected language"] == "Danish"
+    assert "Detected language confidence" in ei.value.document.metadata
+
+
+def test_danish_allowed_passes():
+    f = LanguageDetectionFilter(min_confidence=0.5, allowed_languages=["dan"])
+    out = f.process(doc(DANISH_TEXT))
+    assert out.metadata["Detected language"] == "Danish"
+
+
+def test_low_confidence_filtered():
+    # An impossible threshold forces the confidence branch; the reference's
+    # "satified" typo is part of the reason format (language_filter.rs:75-78).
+    f = LanguageDetectionFilter(min_confidence=1.0, allowed_languages=["eng"])
+    with pytest.raises(DocumentFiltered) as ei:
+        f.process(doc("short text fragment the"))
+    assert "Language detection confidence is not satified" in ei.value.reason
+
+
+def test_undetectable_filtered():
+    f = LanguageDetectionFilter(min_confidence=0.1, allowed_languages=["eng"])
+    with pytest.raises(DocumentFiltered) as ei:
+        f.process(doc("12345 67890 !!!"))
+    assert ei.value.reason == "Language could not be confidently detected"
+
+
+def test_unknown_iso_codes_dropped():
+    f = LanguageDetectionFilter(min_confidence=0.5, allowed_languages=["xx", "eng"])
+    assert f.allowed_languages == ["eng"]
+
+
+def test_multiple_allowed_languages():
+    f = LanguageDetectionFilter(
+        min_confidence=0.5, allowed_languages=["dan", "swe", "nob"]
+    )
+    with pytest.raises(DocumentFiltered) as ei:
+        f.process(doc(ENGLISH_TEXT))
+    assert 'languages: "dan; swe; nob"' in ei.value.reason

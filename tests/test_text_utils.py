@@ -1,0 +1,130 @@
+"""Text primitive tests, ported from the reference's inline suites
+(``/root/reference/src/utils/text.rs:261-467`` and duplicate-helper cases from
+``gopher_rep.rs:246-408``)."""
+
+from textblaster_tpu.utils.text import (
+    DANISH_STOP_WORDS,
+    PUNCTUATION,
+    find_all_duplicate,
+    find_duplicates,
+    find_top_duplicate,
+    get_n_grams,
+    split_into_sentences,
+    split_into_words,
+)
+
+
+class TestSplitSentences:
+    def test_empty_and_simple(self):
+        assert split_into_sentences("") == []
+        assert split_into_sentences("   ") == []
+        assert split_into_sentences("Hello world.") == ["Hello world."]
+        assert split_into_sentences("  Hello world.  ") == ["Hello world."]
+        assert split_into_sentences("Dette er en sætning.") == ["Dette er en sætning."]
+        assert split_into_sentences("SingleWord") == ["SingleWord"]
+        assert split_into_sentences("  SingleWord  ") == ["SingleWord"]
+
+    def test_multiple(self):
+        expected = ["Første sætning.", "Anden sætning!", "Tredje sætning?"]
+        assert (
+            split_into_sentences("Første sætning. Anden sætning! Tredje sætning?")
+            == expected
+        )
+        assert (
+            split_into_sentences("  Første sætning.   Anden sætning!  Tredje sætning?  ")
+            == expected
+        )
+        assert split_into_sentences(" Hello. How are you? Fine! ") == [
+            "Hello.",
+            "How are you?",
+            "Fine!",
+        ]
+        assert split_into_sentences("This is a sentence. This is another") == [
+            "This is a sentence.",
+            "This is another",
+        ]
+        assert split_into_sentences("  This is a sentence.   This is another  ") == [
+            "This is a sentence.",
+            "This is another",
+        ]
+
+    def test_lowercase_continuation_no_break(self):
+        # ICU does not break "e.g. the" style periods followed by lowercase.
+        assert split_into_sentences("Hello. world") == ["Hello. world"]
+
+    def test_newline_is_mandatory_break(self):
+        assert split_into_sentences("One line\nTwo line") == ["One line", "Two line"]
+
+
+class TestSplitWords:
+    def test_empty_and_simple(self):
+        assert split_into_words("") == []
+        assert split_into_words("hello") == ["hello"]
+        assert split_into_words("hello world") == ["hello", "world"]
+
+    def test_with_punctuation(self):
+        assert split_into_words("hello, world!") == ["hello", "world"]
+        assert split_into_words("first. second; third?") == ["first", "second", "third"]
+        assert split_into_words("...leading") == ["leading"]
+        assert split_into_words("trailing...") == ["trailing"]
+        assert split_into_words("mid...dle") == ["mid", "dle"]
+
+    def test_danish(self):
+        assert split_into_words("hej med dig") == ["hej", "med", "dig"]
+        assert split_into_words("en, to, tre!") == ["en", "to", "tre"]
+
+    def test_apostrophes_and_numbers(self):
+        assert split_into_words("don't stop") == ["don't", "stop"]
+        assert split_into_words("1,000.5 items") == ["1,000.5", "items"]
+
+
+class TestPunctuationSet:
+    def test_contents(self):
+        for c in ".,!?\"":
+            assert c in PUNCTUATION
+        assert chr(0) in PUNCTUATION  # control range (0, 9)
+        assert chr(0x1F) in PUNCTUATION  # control range (13, 32)
+        assert "a" not in PUNCTUATION
+        assert "A" not in PUNCTUATION
+        assert "5" not in PUNCTUATION
+        # tab/newline/space are NOT punctuation (ranges exclude 9, 10, 32).
+        assert "\t" not in PUNCTUATION
+        assert "\n" not in PUNCTUATION
+        assert " " not in PUNCTUATION
+
+
+class TestDanishStopWords:
+    def test_simple_check(self):
+        assert len(DANISH_STOP_WORDS) > 0
+        assert "og" in DANISH_STOP_WORDS
+        assert "er" in DANISH_STOP_WORDS
+        assert "hest" not in DANISH_STOP_WORDS
+
+
+class TestNGramHelpers:
+    def test_get_n_grams(self):
+        assert get_n_grams(["a", "b", "c"], 2) == ["a b", "b c"]
+        assert get_n_grams(["a", "b"], 0) == []
+        assert get_n_grams(["a"], 2) == []
+
+    def test_find_duplicates_byte_lengths(self):
+        assert find_duplicates([]) == (0, 0)
+        assert find_duplicates(["x", "y"]) == (0, 0)
+        assert find_duplicates(["x", "x", "y"]) == (1, 1)
+        # Multibyte: "æble" is 5 UTF-8 bytes.
+        assert find_duplicates(["æble", "æble"]) == (1, 5)
+        assert find_duplicates(["a", "a", "a"]) == (2, 2)
+
+    def test_find_top_duplicate(self):
+        assert find_top_duplicate([]) == 0
+        assert find_top_duplicate(["a", "b"]) == 0  # no repeats
+        assert find_top_duplicate(["ab", "ab", "c"]) == 4  # 2 bytes * 2
+        # Tie on count: larger byte contribution wins (text.rs:220-237).
+        assert find_top_duplicate(["aa", "aa", "b", "b"]) == 4
+
+    def test_find_all_duplicate(self):
+        # Worked example from gopher_rep.rs:385-392.
+        assert find_all_duplicate(["a"] * 5, 2) == 4
+        assert find_all_duplicate([], 2) == 0
+        assert find_all_duplicate(["a", "b"], 0) == 0
+        assert find_all_duplicate(["a", "b", "a", "b"], 2) == 2  # "ab" repeats once

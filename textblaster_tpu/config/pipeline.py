@@ -1,0 +1,399 @@
+"""Typed, validated YAML pipeline configuration.
+
+Re-implementation of ``/root/reference/src/config/pipeline.rs``: the same 7
+step types discriminated by a ``type`` field, the same per-params validation
+rules (pipeline.rs:82-367) with matching error messages, and the same loader
+behavior (read file -> YAML parse -> validate, pipeline.rs:372-393).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import yaml
+
+from ..errors import ConfigError, ConfigValidationError
+
+__all__ = [
+    "PipelineConfig",
+    "StepConfig",
+    "C4QualityParams",
+    "GopherRepetitionParams",
+    "GopherQualityParams",
+    "C4BadWordsParams",
+    "LanguageDetectionParams",
+    "FineWebQualityFilterParams",
+    "TokenCounterParams",
+    "load_pipeline_config",
+    "parse_pipeline_config",
+]
+
+
+def _require(d: Dict[str, Any], key: str, step: str) -> Any:
+    if key not in d:
+        raise ConfigError(f"missing field `{key}` for step {step}")
+    return d[key]
+
+
+@dataclass
+class C4QualityParams:
+    """pipeline.rs:67-100"""
+
+    split_paragraph: bool
+    remove_citations: bool
+    filter_no_terminal_punct: bool
+    min_num_sentences: int
+    min_words_per_line: int
+    max_word_length: int
+    filter_lorem_ipsum: bool
+    filter_javascript: bool
+    filter_curly_bracket: bool
+    filter_policy: bool
+
+    def validate(self) -> None:
+        if self.min_num_sentences == 0:
+            raise ConfigValidationError(
+                "C4QualityParams: min_num_sentences must be greater than 0"
+            )
+        if self.min_words_per_line == 0:
+            raise ConfigValidationError(
+                "C4QualityParams: min_words_per_line must be greater than 0"
+            )
+        if self.max_word_length == 0:
+            raise ConfigValidationError(
+                "C4QualityParams: max_word_length must be greater than 0"
+            )
+
+
+@dataclass
+class GopherRepetitionParams:
+    """pipeline.rs:102-159"""
+
+    dup_line_frac: Optional[float] = None
+    dup_para_frac: Optional[float] = None
+    dup_line_char_frac: Optional[float] = None
+    dup_para_char_frac: Optional[float] = None
+    top_n_grams: List[Tuple[int, float]] = field(default_factory=list)
+    dup_n_grams: List[Tuple[int, float]] = field(default_factory=list)
+
+    def validate(self) -> None:
+        fractions = (
+            ("dup_line_frac", self.dup_line_frac),
+            ("dup_para_frac", self.dup_para_frac),
+            ("dup_line_char_frac", self.dup_line_char_frac),
+            ("dup_para_char_frac", self.dup_para_char_frac),
+        )
+        for name, val in fractions:
+            if val is not None and not (0.0 <= val <= 1.0):
+                raise ConfigValidationError(
+                    f"GopherRepetitionParams: {name} must be between 0.0 and 1.0, "
+                    f"got {val}"
+                )
+        for name, n_grams in (
+            ("top_n_grams", self.top_n_grams),
+            ("dup_n_grams", self.dup_n_grams),
+        ):
+            for idx, (size, fraction) in enumerate(n_grams):
+                if size == 0:
+                    raise ConfigValidationError(
+                        f"GopherRepetitionParams: n-gram size in {name} at index "
+                        f"{idx} must be greater than 0"
+                    )
+                if not (0.0 <= fraction <= 1.0):
+                    raise ConfigValidationError(
+                        f"GopherRepetitionParams: n-gram fraction in {name} at "
+                        f"index {idx} must be between 0.0 and 1.0, got {fraction}"
+                    )
+
+
+@dataclass
+class GopherQualityParams:
+    """pipeline.rs:161-258"""
+
+    min_doc_words: Optional[int] = None
+    max_doc_words: Optional[int] = None
+    min_avg_word_length: Optional[float] = None
+    max_avg_word_length: Optional[float] = None
+    max_symbol_word_ratio: Optional[float] = None
+    max_bullet_lines_ratio: Optional[float] = None
+    max_ellipsis_lines_ratio: Optional[float] = None
+    max_non_alpha_words_ratio: Optional[float] = None
+    min_stop_words: Optional[int] = None
+    stop_words: Optional[List[str]] = None
+
+    def validate(self) -> None:
+        if self.min_doc_words is not None and self.min_doc_words == 0:
+            raise ConfigValidationError(
+                "GopherQualityParams: min_doc_words must be greater than 0"
+            )
+        if self.max_doc_words is not None and self.max_doc_words == 0:
+            raise ConfigValidationError(
+                "GopherQualityParams: max_doc_words must be greater than 0"
+            )
+        if (
+            self.min_doc_words is not None
+            and self.max_doc_words is not None
+            and self.min_doc_words > self.max_doc_words
+        ):
+            raise ConfigValidationError(
+                f"GopherQualityParams: min_doc_words ({self.min_doc_words}) cannot "
+                f"be greater than max_doc_words ({self.max_doc_words})"
+            )
+        if self.min_avg_word_length is not None and self.min_avg_word_length <= 0.0:
+            raise ConfigValidationError(
+                "GopherQualityParams: min_avg_word_length must be greater than 0.0"
+            )
+        if self.max_avg_word_length is not None and self.max_avg_word_length <= 0.0:
+            raise ConfigValidationError(
+                "GopherQualityParams: max_avg_word_length must be greater than 0.0"
+            )
+        if (
+            self.min_avg_word_length is not None
+            and self.max_avg_word_length is not None
+            and self.min_avg_word_length > self.max_avg_word_length
+        ):
+            raise ConfigValidationError(
+                f"GopherQualityParams: min_avg_word_length "
+                f"({self.min_avg_word_length}) cannot be greater than "
+                f"max_avg_word_length ({self.max_avg_word_length})"
+            )
+        ratio_params = (
+            ("max_symbol_word_ratio", self.max_symbol_word_ratio),
+            ("max_bullet_lines_ratio", self.max_bullet_lines_ratio),
+            ("max_ellipsis_lines_ratio", self.max_ellipsis_lines_ratio),
+            ("max_non_alpha_words_ratio", self.max_non_alpha_words_ratio),
+        )
+        for name, val in ratio_params:
+            if val is not None and val < 0.0:
+                raise ConfigValidationError(
+                    f"GopherQualityParams: {name} must be non-negative, got {val}"
+                )
+
+
+@dataclass
+class C4BadWordsParams:
+    """pipeline.rs:260-285"""
+
+    keep_fraction: float
+    fail_on_missing_language: bool
+    default_language: str
+    seed: Optional[int] = None
+    cache_base_path: Optional[Path] = None  # not deserialized from YAML (serde skip)
+
+    def validate(self) -> None:
+        if not (0.0 <= self.keep_fraction <= 1.0):
+            raise ConfigValidationError(
+                f"C4BadWordsParams: keep_fraction must be between 0.0 and 1.0, "
+                f"got {self.keep_fraction}"
+            )
+        if not self.default_language:
+            raise ConfigValidationError(
+                "C4BadWordsParams: default_language cannot be empty"
+            )
+
+
+@dataclass
+class LanguageDetectionParams:
+    """pipeline.rs:287-309"""
+
+    min_confidence: float
+    allowed_languages: List[str]
+
+    def validate(self) -> None:
+        if not (0.0 <= self.min_confidence <= 1.0):
+            raise ConfigValidationError(
+                f"LanguageDetectionParams: min_confidence must be between 0.0 and "
+                f"1.0, got {self.min_confidence}"
+            )
+        if not self.allowed_languages:
+            raise ConfigValidationError(
+                "LanguageDetectionParams: allowed_languages cannot be empty"
+            )
+
+
+@dataclass
+class FineWebQualityFilterParams:
+    """pipeline.rs:311-349"""
+
+    line_punct_thr: float = 0.0
+    line_punct_exclude_zero: bool = False
+    short_line_thr: float = 0.0
+    short_line_length: int = 0
+    char_duplicates_ratio: float = 0.0
+    new_line_ratio: float = 0.0
+    stop_chars: Optional[List[str]] = None
+
+    def validate(self) -> None:
+        params = (
+            ("line_punct_thr", self.line_punct_thr),
+            ("short_line_thr", self.short_line_thr),
+            ("char_duplicates_ratio", self.char_duplicates_ratio),
+            ("new_line_ratio", self.new_line_ratio),
+        )
+        for name, value in params:
+            if not (0.0 <= value <= 1.0):
+                raise ConfigValidationError(
+                    f"FineWebQualityFilterParams: {name} must be between 0.0 and "
+                    f"1.0, got {value}"
+                )
+        if self.short_line_length == 0:
+            raise ConfigValidationError(
+                "FineWebQualityFilterParams: short_line_length must be greater than 0"
+            )
+
+
+@dataclass
+class TokenCounterParams:
+    """pipeline.rs:351-368"""
+
+    tokenizer_name: str
+
+    def validate(self) -> None:
+        if not self.tokenizer_name:
+            raise ConfigValidationError(
+                "TokenCounterParams: tokenizer_name cannot be empty"
+            )
+
+
+_PARAM_TYPES = {
+    "C4QualityFilter": C4QualityParams,
+    "GopherRepetitionFilter": GopherRepetitionParams,
+    "GopherQualityFilter": GopherQualityParams,
+    "C4BadWordsFilter": C4BadWordsParams,
+    "LanguageDetectionFilter": LanguageDetectionParams,
+    "FineWebQualityFilter": FineWebQualityFilterParams,
+    "TokenCounter": TokenCounterParams,
+}
+
+_REQUIRED_FIELDS = {
+    "C4QualityFilter": (
+        "split_paragraph",
+        "remove_citations",
+        "filter_no_terminal_punct",
+        "min_num_sentences",
+        "min_words_per_line",
+        "max_word_length",
+        "filter_lorem_ipsum",
+        "filter_javascript",
+        "filter_curly_bracket",
+        "filter_policy",
+    ),
+    "GopherRepetitionFilter": (),
+    "GopherQualityFilter": (),
+    "C4BadWordsFilter": ("keep_fraction", "fail_on_missing_language", "default_language"),
+    "LanguageDetectionFilter": ("min_confidence", "allowed_languages"),
+    "FineWebQualityFilter": (
+        "line_punct_thr",
+        "line_punct_exclude_zero",
+        "short_line_thr",
+        "short_line_length",
+        "char_duplicates_ratio",
+        "new_line_ratio",
+    ),
+    "TokenCounter": ("tokenizer_name",),
+}
+
+# Fields serde skips during deserialization (pipeline.rs:266-267).
+_SKIPPED_FIELDS = {"C4BadWordsFilter": ("cache_base_path",)}
+
+
+@dataclass
+class StepConfig:
+    """One pipeline step: a type tag + typed params (pipeline.rs:26-64)."""
+
+    type: str
+    params: Any
+
+    @property
+    def name(self) -> str:
+        return self.type
+
+    def validate(self) -> None:
+        self.params.validate()
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "StepConfig":
+        if not isinstance(d, dict) or "type" not in d:
+            raise ConfigError("pipeline step is missing the `type` tag")
+        step_type = d["type"]
+        if step_type not in _PARAM_TYPES:
+            raise ConfigError(
+                f"unknown variant `{step_type}`, expected one of "
+                + ", ".join(f"`{t}`" for t in _PARAM_TYPES)
+            )
+        fields_d = {k: v for k, v in d.items() if k != "type"}
+        for skipped in _SKIPPED_FIELDS.get(step_type, ()):
+            fields_d.pop(skipped, None)
+        for req in _REQUIRED_FIELDS[step_type]:
+            _require(fields_d, req, step_type)
+        param_cls = _PARAM_TYPES[step_type]
+        # serde without deny_unknown_fields silently ignores extra keys
+        # (pipeline.rs:26-37) — e.g. legacy `language:` keys in FineWeb steps.
+        known = set(param_cls.__dataclass_fields__)
+        fields_d = {k: v for k, v in fields_d.items() if k in known}
+        # Normalize [ [n, frac], ... ] lists into tuples.
+        for key in ("top_n_grams", "dup_n_grams"):
+            if key in fields_d and fields_d[key] is not None:
+                try:
+                    fields_d[key] = [(int(n), float(f)) for n, f in fields_d[key]]
+                except (TypeError, ValueError) as e:
+                    raise ConfigError(
+                        f"invalid {key} for step {step_type}: {e}"
+                    ) from e
+        try:
+            params = param_cls(**fields_d)
+        except TypeError as e:
+            raise ConfigError(f"invalid params for step {step_type}: {e}") from e
+        return cls(type=step_type, params=params)
+
+
+@dataclass
+class PipelineConfig:
+    """pipeline.rs:10-22"""
+
+    pipeline: List[StepConfig]
+
+    def validate(self) -> None:
+        for step in self.pipeline:
+            step.validate()
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "PipelineConfig":
+        if not isinstance(d, dict) or "pipeline" not in d:
+            raise ConfigError("missing field `pipeline`")
+        steps_raw = d["pipeline"]
+        if steps_raw is None or not isinstance(steps_raw, list):
+            raise ConfigError("`pipeline` must be a list of steps")
+        return cls(pipeline=[StepConfig.from_dict(s) for s in steps_raw])
+
+
+def parse_pipeline_config(content: str, origin: str = "<string>") -> PipelineConfig:
+    """Parse + validate YAML content (split out for broker-free tests)."""
+    try:
+        raw = yaml.safe_load(content)
+    except yaml.YAMLError as e:
+        raise ConfigError(
+            f"Failed to parse pipeline config YAML from '{origin}': {e}"
+        ) from e
+    try:
+        config = PipelineConfig.from_dict(raw if raw is not None else {})
+    except ConfigError as e:
+        raise ConfigError(
+            f"Failed to parse pipeline config YAML from '{origin}': {e.args[0]}"
+        ) from e
+    config.validate()
+    return config
+
+
+def load_pipeline_config(config_path: str | Path) -> PipelineConfig:
+    """Load and parse a pipeline YAML (pipeline.rs:372-393)."""
+    path = Path(config_path)
+    try:
+        content = path.read_text(encoding="utf-8")
+    except OSError as e:
+        raise ConfigError(
+            f"Failed to read pipeline config file '{path}': {e}"
+        ) from e
+    return parse_pipeline_config(content, origin=str(path))

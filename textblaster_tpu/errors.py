@@ -1,0 +1,109 @@
+"""Error taxonomy for the pipeline.
+
+Mirrors the reference's ``PipelineError`` enum (``/root/reference/src/error.rs:9-61``)
+including the load-bearing control-flow trick: a filter signaling "drop this
+document" raises :class:`DocumentFiltered` carrying the (mutated) document and a
+human-readable reason; the executor wraps any step failure in :class:`StepError`
+naming the step (reference ``error.rs:39-43``).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Optional
+
+if TYPE_CHECKING:  # pragma: no cover
+    from .data_model import TextDocument
+
+__all__ = [
+    "PipelineError",
+    "ConfigError",
+    "ConfigValidationError",
+    "IoError",
+    "ParquetError",
+    "DocumentFiltered",
+    "StepError",
+    "QueueError",
+    "SerializationError",
+    "UnexpectedError",
+]
+
+
+class PipelineError(Exception):
+    """Base class for every pipeline error (reference ``error.rs:10``)."""
+
+
+class ConfigError(PipelineError):
+    """Configuration error, e.g. unreadable/unparseable config file
+    (reference ``error.rs:11-12``)."""
+
+    def __str__(self) -> str:
+        return f"Configuration error: {self.args[0] if self.args else ''}"
+
+
+class ConfigValidationError(PipelineError):
+    """Configuration validation error (reference ``error.rs:55-56``)."""
+
+    def __str__(self) -> str:
+        return f"Configuration validation error: {self.args[0] if self.args else ''}"
+
+
+class IoError(PipelineError):
+    """I/O error (reference ``error.rs:14-18``)."""
+
+
+class ParquetError(PipelineError):
+    """Parquet read/write error (reference ``error.rs:20-30``, merging the
+    Parquet and Arrow variants — pyarrow has a single error surface)."""
+
+
+class DocumentFiltered(PipelineError):
+    """A step decided to drop the document (reference ``error.rs:33-37``).
+
+    Carries the document *as mutated by the step* (status/reason metadata is
+    stamped before raising — quirk #1 in SURVEY.md §7) plus the reason string
+    that ends up in the excluded-file metadata and outcome.
+    """
+
+    def __init__(self, document: "TextDocument", reason: str) -> None:
+        super().__init__(reason)
+        self.document = document
+        self.reason = reason
+
+    def __str__(self) -> str:
+        return f"Document '{self.document.id}' filtered out: {self.reason}"
+
+
+class StepError(PipelineError):
+    """A pipeline step failed; wraps the underlying error with the step name
+    (reference ``error.rs:39-43``)."""
+
+    def __init__(self, step_name: str, source: PipelineError) -> None:
+        super().__init__(step_name, source)
+        self.step_name = step_name
+        self.source = source
+
+    def __str__(self) -> str:
+        return f"Error in processing step '{self.step_name}': {self.source}"
+
+    def filtered(self) -> Optional[DocumentFiltered]:
+        """Return the inner DocumentFiltered if this StepError wraps one."""
+        return self.source if isinstance(self.source, DocumentFiltered) else None
+
+
+class QueueError(PipelineError):
+    """Result/feed transport error (reference ``error.rs:46-47``; in this
+    framework the 'queue' is the host<->device feed/collective path)."""
+
+    def __str__(self) -> str:
+        return f"Queueing system error: {self.args[0] if self.args else ''}"
+
+
+class SerializationError(PipelineError):
+    """JSON (de)serialization error (reference ``error.rs:49-53``)."""
+
+
+class UnexpectedError(PipelineError):
+    """Catch-all (reference ``error.rs:58-59``)."""
+
+    def __str__(self) -> str:
+        return f"Unexpected error: {self.args[0] if self.args else ''}"

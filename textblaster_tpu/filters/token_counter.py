@@ -1,0 +1,54 @@
+"""Token counting step (never filters).
+
+Re-implementation of ``TokenCounter``
+(``/root/reference/src/pipeline/token/token_counter.rs:8-43``): loads a
+HuggingFace tokenizer at build time, encodes content *with* special tokens,
+and stamps ``metadata["token_count"]``.
+
+Loading resolution order (the reference only supports hub fetch,
+token_counter.rs:14; this build adds offline paths first since TPU pods are
+often egress-less):
+
+1. a local path to a ``tokenizer.json`` file or a directory containing one;
+2. the HuggingFace hub cache / network via ``tokenizers.Tokenizer.from_pretrained``.
+
+A load failure raises ``UnexpectedError("Error in loading tokenizer")`` at
+construction, matching the reference's build-time failure surface
+(worker_logic.rs:115-122 panics on it).
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..data_model import TextDocument
+from ..errors import UnexpectedError
+from ..executor import ProcessingStep
+
+__all__ = ["TokenCounter"]
+
+
+class TokenCounter(ProcessingStep):
+    name = "TokenCounter"
+
+    def __init__(self, tokenizer_name: str) -> None:
+        try:
+            from tokenizers import Tokenizer
+
+            path = tokenizer_name
+            if os.path.isdir(path):
+                path = os.path.join(path, "tokenizer.json")
+            if os.path.isfile(path):
+                self._tokenizer = Tokenizer.from_file(path)
+            else:
+                self._tokenizer = Tokenizer.from_pretrained(tokenizer_name)
+        except Exception as e:
+            raise UnexpectedError("Error in loading tokenizer") from e
+
+    def process(self, document: TextDocument) -> TextDocument:
+        try:
+            encoding = self._tokenizer.encode(document.content, add_special_tokens=True)
+        except Exception as e:
+            raise UnexpectedError(str(e)) from e
+        document.metadata["token_count"] = str(len(encoding.tokens))
+        return document
