@@ -1,0 +1,173 @@
+"""Parquet I/O tests, following ``/root/reference/tests/parquet_io_test.rs``:
+write->read roundtrip of every field, missing-column errors, and third-party
+(raw pyarrow) cross-reads as the independent oracle."""
+
+import json
+from datetime import date, datetime
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from textblaster_tpu.data_model import TextDocument
+from textblaster_tpu.errors import ConfigError, PipelineError, UnexpectedError
+from textblaster_tpu.io import (
+    ParquetInputConfig,
+    ParquetReader,
+    ParquetWriter,
+)
+
+
+def make_docs():
+    return [
+        TextDocument(
+            id="doc1",
+            content="First document content.",
+            source="src-a",
+            added=date(2024, 3, 1),
+            created=(datetime(2024, 1, 1, 10, 0, 0), datetime(2024, 1, 2, 11, 30, 0)),
+            metadata={"k": "v", "lang": "da"},
+        ),
+        TextDocument(id="doc2", content="Second doc.", source="src-b"),
+    ]
+
+
+def test_write_read_roundtrip(tmp_path):
+    path = str(tmp_path / "out.parquet")
+    w = ParquetWriter(path)
+    w.write_batch(make_docs())
+    w.close()
+
+    reader = ParquetReader(ParquetInputConfig(path, "text", "id"))
+    docs = list(reader.read_documents())
+    assert len(docs) == 2
+    d1, d2 = docs
+    assert isinstance(d1, TextDocument)
+    assert d1.id == "doc1"
+    assert d1.content == "First document content."
+    assert d1.source == "src-a"
+    assert d1.added == date(2024, 3, 1)
+    assert d1.created == (
+        datetime(2024, 1, 1, 10, 0, 0),
+        datetime(2024, 1, 2, 11, 30, 0),
+    )
+    assert d1.metadata == {"k": "v", "lang": "da"}
+    assert d2.added is None and d2.created is None and d2.metadata == {}
+
+
+def test_empty_metadata_written_as_null(tmp_path):
+    path = str(tmp_path / "out.parquet")
+    w = ParquetWriter(path)
+    w.write_batch([TextDocument(id="x", content="c", source="s")])
+    w.close()
+    table = pq.read_table(path)  # independent reader as oracle
+    assert table.column("metadata")[0].as_py() is None
+
+
+def test_metadata_json_column(tmp_path):
+    path = str(tmp_path / "out.parquet")
+    w = ParquetWriter(path)
+    w.write_batch(
+        [TextDocument(id="x", content="c", source="s", metadata={"a": "1"})]
+    )
+    w.close()
+    raw = pq.read_table(path).column("metadata")[0].as_py()
+    assert json.loads(raw) == {"a": "1"}
+
+
+def test_missing_required_column(tmp_path):
+    path = str(tmp_path / "in.parquet")
+    pq.write_table(pa.table({"text": ["a"], "other": ["b"]}), path)
+    reader = ParquetReader(ParquetInputConfig(path, "text", "id"))
+    with pytest.raises(ConfigError) as ei:
+        list(reader.read_documents())
+    assert "Required column 'id' not found in schema." in str(ei.value)
+
+
+def test_non_string_text_column(tmp_path):
+    path = str(tmp_path / "in.parquet")
+    pq.write_table(pa.table({"text": [1, 2], "id": ["a", "b"]}), path)
+    reader = ParquetReader(ParquetInputConfig(path, "text", "id"))
+    with pytest.raises(ConfigError) as ei:
+        list(reader.read_documents())
+    assert "must be Utf8 or LargeUtf8" in str(ei.value)
+
+
+def test_null_rows_yield_per_row_errors(tmp_path):
+    path = str(tmp_path / "in.parquet")
+    pq.write_table(
+        pa.table({"text": ["ok", None, "ok2"], "id": ["1", "2", None]}), path
+    )
+    reader = ParquetReader(ParquetInputConfig(path, "text", "id"))
+    results = list(reader.read_documents())
+    assert isinstance(results[0], TextDocument)
+    assert isinstance(results[1], UnexpectedError)
+    assert "null text column" in str(results[1])
+    assert isinstance(results[2], UnexpectedError)
+    assert "null id column" in str(results[2])
+
+
+def test_html_entities_decoded(tmp_path):
+    # parquet_reader.rs:177-179 quirk #4.
+    path = str(tmp_path / "in.parquet")
+    pq.write_table(
+        pa.table({"text": ["Tom &amp; Jerry &lt;3"], "id": ["1"]}), path
+    )
+    reader = ParquetReader(ParquetInputConfig(path, "text", "id"))
+    [doc] = list(reader.read_documents())
+    assert doc.content == "Tom & Jerry <3"
+
+
+def test_source_fallback_to_path(tmp_path):
+    path = str(tmp_path / "in.parquet")
+    pq.write_table(pa.table({"text": ["a"], "id": ["1"]}), path)
+    reader = ParquetReader(ParquetInputConfig(path, "text", "id"))
+    [doc] = list(reader.read_documents())
+    assert doc.source == path
+
+
+def test_bad_metadata_json_warns_and_empties(tmp_path):
+    path = str(tmp_path / "in.parquet")
+    pq.write_table(
+        pa.table({"text": ["a"], "id": ["1"], "metadata": ["{not json"]}), path
+    )
+    reader = ParquetReader(ParquetInputConfig(path, "text", "id"))
+    [doc] = list(reader.read_documents())
+    assert doc.metadata == {}
+
+
+def test_custom_column_names(tmp_path):
+    path = str(tmp_path / "in.parquet")
+    pq.write_table(
+        pa.table({"body": ["content here"], "uuid": ["u-1"]}), path
+    )
+    reader = ParquetReader(ParquetInputConfig(path, "body", "uuid"))
+    [doc] = list(reader.read_documents())
+    assert doc.id == "u-1" and doc.content == "content here"
+
+
+def test_added_from_timestamp_column(tmp_path):
+    # added may be a microsecond timestamp -> date (parquet_reader.rs:54-59).
+    path = str(tmp_path / "in.parquet")
+    pq.write_table(
+        pa.table(
+            {
+                "text": ["a"],
+                "id": ["1"],
+                "added": pa.array([datetime(2023, 5, 6, 7, 8)], pa.timestamp("us")),
+            }
+        ),
+        path,
+    )
+    reader = ParquetReader(ParquetInputConfig(path, "text", "id"))
+    [doc] = list(reader.read_documents())
+    assert doc.added == date(2023, 5, 6)
+
+
+def test_write_after_close_raises(tmp_path):
+    path = str(tmp_path / "out.parquet")
+    w = ParquetWriter(path)
+    w.write_batch([TextDocument(id="x", content="c", source="s")])
+    w.close()
+    with pytest.raises(PipelineError):
+        w.write_batch([TextDocument(id="y", content="c", source="s")])

@@ -1,0 +1,140 @@
+"""Command-line interface.
+
+Single entry point replacing the reference's two binaries
+(``/root/reference/src/bin/producer.rs``, ``bin/worker.rs``): there is no
+broker to stand between a producer and workers, so one ``run`` command reads
+Parquet, executes the pipeline (host oracle or compiled TPU path), and writes
+the kept/excluded Parquet pair.  ``validate-config`` is the reference worker's
+``--validate-config`` fast path (bin/worker.rs:29-51).
+
+Argument names mirror the reference's clap definitions
+(``config/producer.rs:7-47``, ``config/worker.rs:8-39``) minus the AMQP knobs,
+which have no equivalent here.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from . import __version__
+from .config.pipeline import load_pipeline_config
+from .errors import PipelineError
+from .utils.logging_setup import init_logging
+from .utils.metrics import METRICS, setup_prometheus_metrics
+
+__all__ = ["main", "build_parser"]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="textblast",
+        description="TPU-native text-dataset cleaning pipeline",
+    )
+    p.add_argument("--version", action="version", version=f"%(prog)s {__version__}")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    run = sub.add_parser("run", help="Process a Parquet shard through the pipeline")
+    run.add_argument("-i", "--input-file", required=True,
+                     help="Path to the input Parquet file")
+    run.add_argument("--text-column", default="text",
+                     help="Text column name in the Parquet file")
+    run.add_argument("--id-column", default="id",
+                     help="ID column name in the Parquet file")
+    run.add_argument("-c", "--pipeline-config",
+                     default="configs/pipeline_config.yaml",
+                     help="Path to the pipeline configuration YAML file")
+    run.add_argument("-o", "--output-file", default="output_processed.parquet",
+                     help="Path to the output Parquet file")
+    run.add_argument("-e", "--excluded-file", default="excluded.parquet",
+                     help="Path to the excluded output Parquet file")
+    run.add_argument("--backend", choices=("host", "tpu"), default="tpu",
+                     help="Execution backend: compiled TPU pipeline or host oracle")
+    run.add_argument("--batch-size", type=int, default=1024,
+                     help="Parquet read batch size")
+    run.add_argument("--device-batch", type=int, default=None,
+                     help="Documents per device batch (tpu backend)")
+    run.add_argument("--metrics-port", type=int, default=None,
+                     help="Port for the Prometheus metrics HTTP endpoint")
+    run.add_argument("--quiet", action="store_true", help="Suppress progress output")
+
+    val = sub.add_parser("validate-config",
+                         help="Validate a pipeline configuration and exit")
+    val.add_argument("-c", "--pipeline-config",
+                     default="configs/pipeline_config.yaml")
+    return p
+
+
+def _cmd_validate(args: argparse.Namespace) -> int:
+    # bin/worker.rs:29-51: load+validate, exit 0/1.
+    try:
+        config = load_pipeline_config(args.pipeline_config)
+    except PipelineError as e:
+        print(f"Configuration is invalid: {e}", file=sys.stderr)
+        return 1
+    print(
+        f"Configuration at '{args.pipeline_config}' is valid "
+        f"({len(config.pipeline)} steps: "
+        + ", ".join(s.type for s in config.pipeline)
+        + ")"
+    )
+    return 0
+
+
+def _cmd_run(args: argparse.Namespace) -> int:
+    init_logging("textblast")
+    setup_prometheus_metrics(args.metrics_port)
+
+    try:
+        config = load_pipeline_config(args.pipeline_config)
+    except PipelineError as e:
+        print(f"Failed to load pipeline config: {e}", file=sys.stderr)
+        return 1
+
+    start = time.perf_counter()
+
+    from .parallel.runner import run_pipeline
+
+    try:
+        result = run_pipeline(
+            config=config,
+            input_file=args.input_file,
+            output_file=args.output_file,
+            excluded_file=args.excluded_file,
+            text_column=args.text_column,
+            id_column=args.id_column,
+            backend=args.backend,
+            read_batch_size=args.batch_size,
+            device_batch=args.device_batch,
+            quiet=args.quiet,
+        )
+    except PipelineError as e:
+        print(f"Pipeline run failed: {e}", file=sys.stderr)
+        return 1
+
+    elapsed = time.perf_counter() - start
+    total = result.received
+    rate = total / elapsed if elapsed > 0 else 0.0
+    # Final summary (bin/producer.rs:169-181).
+    print(
+        f"Processed {total} documents in {elapsed:.2f}s ({rate:.1f} docs/sec): "
+        f"{result.success} kept -> {args.output_file}, "
+        f"{result.filtered} excluded -> {args.excluded_file}, "
+        f"{result.errors} errored (in neither file)."
+    )
+    if result.read_errors:
+        print(f"Warning: {result.read_errors} rows could not be read.",
+              file=sys.stderr)
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.command == "validate-config":
+        return _cmd_validate(args)
+    return _cmd_run(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

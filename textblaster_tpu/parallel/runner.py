@@ -1,0 +1,113 @@
+"""End-to-end pipeline runner.
+
+Replaces the reference's producer/worker/RabbitMQ triangle
+(SURVEY.md §2.5-2.6) with a single in-process data path:
+
+    Parquet row-groups -> packed byte batches -> compiled filter pipeline
+    (sharded over the `data` mesh axis) -> keep/drop masks + stats ->
+    outcomes -> kept/excluded Parquet pair.
+
+Two backends share the orchestration:
+
+* ``host`` — the CPU oracle executor, one document at a time.  This is the
+  parity baseline (and the reference-equivalent measurement side).
+* ``tpu`` — the compiled device pipeline (:mod:`textblaster_tpu.ops`); steps
+  with no device kernel (TokenCounter, C4BadWords) run as host post-passes
+  over the device survivors, preserving the sequential observable semantics.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+from typing import Optional
+
+from ..config.pipeline import PipelineConfig
+from ..data_model import ProcessingOutcome
+from ..orchestration import (
+    AggregationResult,
+    aggregate_results_from_stream,
+    process_documents_host,
+    read_documents,
+)
+from ..pipeline_builder import build_pipeline_from_config
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["run_pipeline"]
+
+
+class _Progress:
+    """Single-line progress display (the reference's indicatif bars,
+    bin/producer.rs:31-46)."""
+
+    def __init__(self, enabled: bool) -> None:
+        self.enabled = enabled and sys.stderr.isatty()
+        self._last = 0
+
+    def update(self, result: AggregationResult) -> None:
+        if not self.enabled:
+            return
+        if result.received - self._last >= 100 or result.received < 100:
+            print(
+                f"\rprocessed={result.received} kept={result.success} "
+                f"excluded={result.filtered} errors={result.errors}",
+                end="",
+                file=sys.stderr,
+            )
+            self._last = result.received
+
+    def finish(self) -> None:
+        if self.enabled:
+            print(file=sys.stderr)
+
+
+def run_pipeline(
+    config: PipelineConfig,
+    input_file: str,
+    output_file: str,
+    excluded_file: str,
+    text_column: str = "text",
+    id_column: str = "id",
+    backend: str = "tpu",
+    read_batch_size: int = 1024,
+    device_batch: Optional[int] = None,
+    quiet: bool = False,
+) -> AggregationResult:
+    progress = _Progress(enabled=not quiet)
+    read_errors = [0]
+
+    def on_read_error(_err) -> None:
+        read_errors[0] += 1
+
+    docs = read_documents(
+        input_file,
+        text_column=text_column,
+        id_column=id_column,
+        batch_size=read_batch_size,
+    )
+
+    if backend == "tpu":
+        from ..ops.pipeline import process_documents_device
+
+        outcomes = process_documents_device(
+            config,
+            docs,
+            device_batch=device_batch,
+            on_read_error=on_read_error,
+        )
+    else:
+        executor = build_pipeline_from_config(config)
+        outcomes = process_documents_host(
+            executor, docs, on_read_error=on_read_error
+        )
+
+    result = aggregate_results_from_stream(
+        outcomes,
+        output_file=output_file,
+        excluded_file=excluded_file,
+        progress=progress.update,
+    )
+    progress.finish()
+    result.read_errors = read_errors[0]
+    return result

@@ -1,0 +1,171 @@
+"""Prometheus-compatible metrics registry + HTTP ``/metrics`` endpoint.
+
+Re-implementation of ``/root/reference/src/utils/prometheus_metrics.rs``: the
+same metric names (9 producer-side + 7 worker-side, rs:16-143) exposed in
+Prometheus text format over HTTP (rs:148-201).  Implemented with a
+dependency-free registry and ``http.server`` in a daemon thread; a bind
+failure is logged, not fatal (rs:186-195 parity).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import defaultdict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["Metrics", "METRICS", "setup_prometheus_metrics"]
+
+# Histogram buckets mirroring the reference's defaults (prometheus crate).
+_DEFAULT_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+# Metric name -> (type, help) — prometheus_metrics.rs:16-143.
+_SPECS: Dict[str, Tuple[str, str]] = {
+    # Producer side
+    "producer_tasks_published_total": ("counter", "Total number of tasks published"),
+    "producer_task_publish_errors_total": ("counter", "Task publish errors"),
+    "producer_results_received_total": ("counter", "Total outcomes received"),
+    "producer_results_success_total": ("counter", "Successful outcomes received"),
+    "producer_results_filtered_total": ("counter", "Filtered outcomes received"),
+    "producer_results_error_total": ("counter", "Error outcomes received"),
+    "producer_results_deserialization_errors_total": (
+        "counter",
+        "Outcome deserialization errors",
+    ),
+    "producer_active_tasks_in_flight": ("gauge", "Tasks in flight"),
+    "producer_task_publishing_duration_seconds": (
+        "histogram",
+        "Task publishing latency",
+    ),
+    # Worker side
+    "worker_tasks_processed_total": ("counter", "Documents fully processed"),
+    "worker_tasks_filtered_total": ("counter", "Documents filtered"),
+    "worker_tasks_failed_total": ("counter", "Documents that hard-errored"),
+    "worker_task_deserialization_errors_total": (
+        "counter",
+        "Task deserialization errors",
+    ),
+    "worker_outcome_publish_errors_total": ("counter", "Outcome publish errors"),
+    "worker_task_processing_duration_seconds": (
+        "histogram",
+        "Per-document processing duration",
+    ),
+    "worker_active_tasks": ("gauge", "Documents currently being processed"),
+}
+
+
+class Metrics:
+    """Thread-safe counter/gauge/histogram registry."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._values: Dict[str, float] = defaultdict(float)
+        self._hist_counts: Dict[str, List[int]] = {}
+        self._hist_sum: Dict[str, float] = defaultdict(float)
+        self._hist_total: Dict[str, int] = defaultdict(int)
+
+    def inc(self, name: str, amount: float = 1.0) -> None:
+        with self._lock:
+            self._values[name] += amount
+
+    def dec(self, name: str, amount: float = 1.0) -> None:
+        with self._lock:
+            self._values[name] -= amount
+
+    def set(self, name: str, value: float) -> None:
+        with self._lock:
+            self._values[name] = value
+
+    def get(self, name: str) -> float:
+        with self._lock:
+            return self._values.get(name, 0.0)
+
+    def observe(self, name: str, value: float) -> None:
+        with self._lock:
+            if name not in self._hist_counts:
+                self._hist_counts[name] = [0] * (len(_DEFAULT_BUCKETS) + 1)
+            counts = self._hist_counts[name]
+            for i, b in enumerate(_DEFAULT_BUCKETS):
+                if value <= b:
+                    counts[i] += 1
+                    break
+            else:
+                counts[-1] += 1
+            self._hist_sum[name] += value
+            self._hist_total[name] += 1
+
+    def reset(self) -> None:
+        with self._lock:
+            self._values.clear()
+            self._hist_counts.clear()
+            self._hist_sum.clear()
+            self._hist_total.clear()
+
+    def render(self) -> str:
+        """Prometheus text exposition format."""
+        with self._lock:
+            lines: List[str] = []
+            for name, (mtype, help_text) in _SPECS.items():
+                if mtype in ("counter", "gauge"):
+                    lines.append(f"# HELP {name} {help_text}")
+                    lines.append(f"# TYPE {name} {mtype}")
+                    lines.append(f"{name} {self._values.get(name, 0.0):g}")
+                else:
+                    lines.append(f"# HELP {name} {help_text}")
+                    lines.append(f"# TYPE {name} histogram")
+                    counts = self._hist_counts.get(
+                        name, [0] * (len(_DEFAULT_BUCKETS) + 1)
+                    )
+                    cumulative = 0
+                    for i, b in enumerate(_DEFAULT_BUCKETS):
+                        cumulative += counts[i]
+                        lines.append(f'{name}_bucket{{le="{b:g}"}} {cumulative}')
+                    cumulative += counts[-1]
+                    lines.append(f'{name}_bucket{{le="+Inf"}} {cumulative}')
+                    lines.append(f"{name}_sum {self._hist_sum.get(name, 0.0):g}")
+                    lines.append(f"{name}_count {self._hist_total.get(name, 0)}")
+            return "\n".join(lines) + "\n"
+
+
+#: Process-wide registry (the reference's lazy statics, rs:16-143).
+METRICS = Metrics()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802
+        if self.path != "/metrics":
+            self.send_response(404)
+            self.end_headers()
+            return
+        body = METRICS.render().encode("utf-8")
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # silence request logging
+        logger.debug("metrics: " + fmt, *args)
+
+
+def setup_prometheus_metrics(port: Optional[int]) -> Optional[ThreadingHTTPServer]:
+    """Serve ``/metrics`` on the given port in a daemon thread
+    (prometheus_metrics.rs:148-201).  Returns the server, or None if no port
+    was requested or the bind failed (bind failure only logged, rs:186-195).
+    """
+    if port is None:
+        return None
+    try:
+        server = ThreadingHTTPServer(("0.0.0.0", port), _Handler)
+    except OSError as e:
+        logger.error("Failed to bind metrics server on port %s: %s", port, e)
+        return None
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    logger.info("Metrics server listening on port %s", port)
+    return server
