@@ -1,0 +1,135 @@
+"""Benchmark: full-pipeline docs/sec/chip, device path vs CPU oracle baseline.
+
+Measures the BASELINE.json metric — documents/second/chip through the full
+Danish cleaning pipeline (langid + Gopher repetition + Gopher quality + C4 +
+FineWeb) at decision parity with the CPU reference path — on a synthetic
+CC-MAIN-like shard (seeded generator; the environment has no network for a
+real CC fetch).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "docs/s", "vs_baseline": N}
+where vs_baseline is the speedup of the compiled device path over the
+single-process CPU oracle on the same shard.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+N_DOCS = 4096
+CPU_SAMPLE = 384  # oracle subsample, extrapolated
+SEED = 20260729
+
+_DANISH_WORDS = (
+    "det er en god dag og vi skal ud at gå tur i skoven solen skinner over "
+    "byen der mange mennesker på gaden som arbejde nu efter turen vil gerne "
+    "drikke kop kaffe spise lidt brød hjemme haven igen bliver dejlig "
+    "eftermiddag fordi vejret så godt børnene kommer fra skole aftenen lave "
+    "mad sammen se film stuen før seng huset store vinduer mod syd lyset "
+    "falder ind om morgenen når står op tidligt cyklen til byen langs vandet "
+    "møder venner torvet taler længe gamle dage planlægger næste rejse sydpå"
+).split()
+
+_ENGLISH_WORDS = (
+    "the quick brown fox jumps over lazy dog and runs through green fields "
+    "near river where people walk their dogs every morning before work they "
+    "stop for coffee at small cafe on corner watching boats pass slowly under "
+    "old stone bridge while children play in park across street from market"
+).split()
+
+
+def _make_docs(rng: np.random.Generator):
+    from textblaster_tpu.data_model import TextDocument
+
+    docs = []
+    for i in range(N_DOCS):
+        kind = rng.random()
+        words = _DANISH_WORDS if kind < 0.7 else _ENGLISH_WORDS
+        n_sentences = int(rng.integers(3, 40))
+        lines = []
+        for _ in range(n_sentences):
+            n_w = int(rng.integers(4, 18))
+            ws = [words[int(rng.integers(0, len(words)))] for _ in range(n_w)]
+            sent = " ".join(ws).capitalize() + "."
+            lines.append(sent)
+        # Group sentences into lines/paragraphs like web text.
+        content_parts = []
+        j = 0
+        while j < len(lines):
+            k = int(rng.integers(1, 5))
+            content_parts.append(" ".join(lines[j : j + k]))
+            j += k
+        content = "\n".join(content_parts)
+        if kind > 0.95:
+            content = "Samme linje her igen.\n" * int(rng.integers(5, 30))
+        elif kind > 0.9:
+            content = content[: int(rng.integers(10, 60))]
+        docs.append(TextDocument(id=f"doc-{i}", source="bench", content=content))
+    return docs
+
+
+def main() -> int:
+    from textblaster_tpu.config.pipeline import parse_pipeline_config
+    from textblaster_tpu.ops.pipeline import process_documents_device
+    from textblaster_tpu.orchestration import process_documents_host
+    from textblaster_tpu.pipeline_builder import build_pipeline_from_config
+
+    with open("configs/pipeline_config.yaml", encoding="utf-8") as f:
+        import yaml as _yaml
+
+        raw = _yaml.safe_load(f)
+    # TokenCounter needs a hub tokenizer (network); bench the device-covered
+    # pipeline.
+    raw["pipeline"] = [s for s in raw["pipeline"] if s["type"] != "TokenCounter"]
+    config = parse_pipeline_config(_yaml.safe_dump(raw))
+
+    rng = np.random.default_rng(SEED)
+    docs = _make_docs(rng)
+
+    # --- CPU oracle baseline (single process; the reference-equivalent path).
+    executor = build_pipeline_from_config(config)
+    sample = [d.copy() for d in docs[:CPU_SAMPLE]]
+    t0 = time.perf_counter()
+    host_outcomes = list(process_documents_host(executor, iter(sample)))
+    cpu_elapsed = time.perf_counter() - t0
+    cpu_rate = len(sample) / cpu_elapsed
+
+    # --- Device path: warmup (compile) then timed run.
+    warm = [d.copy() for d in docs[:256]]
+    list(process_documents_device(config, iter(warm), device_batch=256))
+
+    run_docs = [d.copy() for d in docs]
+    t0 = time.perf_counter()
+    dev_outcomes = list(
+        process_documents_device(config, iter(run_docs), device_batch=256)
+    )
+    dev_elapsed = time.perf_counter() - t0
+    dev_rate = len(run_docs) / dev_elapsed
+
+    # --- Decision parity check on the CPU subsample.
+    host_by_id = {o.document.id: o.kind for o in host_outcomes}
+    dev_by_id = {o.document.id: o.kind for o in dev_outcomes}
+    agree = sum(
+        1 for k, v in host_by_id.items() if dev_by_id.get(k) == v
+    )
+    parity = agree / max(len(host_by_id), 1)
+
+    result = {
+        "metric": "docs_per_sec_per_chip_full_danish_pipeline",
+        "value": round(dev_rate, 2),
+        "unit": "docs/s",
+        "vs_baseline": round(dev_rate / cpu_rate, 3),
+        "cpu_baseline_docs_per_sec": round(cpu_rate, 2),
+        "decision_parity": round(parity, 6),
+        "n_docs": len(run_docs),
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

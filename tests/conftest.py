@@ -1,19 +1,26 @@
 """Test configuration.
 
-Multi-chip tests run on a virtual 8-device CPU mesh
-(``XLA_FLAGS=--xla_force_host_platform_device_count=8``), standing in for the
+Tests run on a virtual 8-device CPU mesh
+(``--xla_force_host_platform_device_count=8``), standing in for the
 reference's testcontainers-based multi-process broker tests (SURVEY.md §4).
-The env vars must be set before jax is first imported anywhere.
+
+The environment may pin ``JAX_PLATFORMS`` to a hardware plugin at interpreter
+startup, so the platform is forced to CPU via ``jax.config`` (which wins over
+the env var) before any backend initializes.  ``XLA_FLAGS`` must be extended
+before the first jax import.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -140,11 +140,18 @@ def _normalize_codepoints(text: str) -> List[int]:
     return out
 
 
+# Fixed-point scale for the log-prob table: scores are summed as exact int32
+# millinats on both host and device, so detection decisions are bit-identical
+# across the two paths (no float accumulation-order dependence).
+SCORE_SCALE = 1000.0
+
+
 class LangIdModel:
     """Hashed-trigram naive-Bayes detector over the fixed candidate set."""
 
     def __init__(self) -> None:
         self.table = self._build_table()  # [TABLE_SIZE, n_langs] float32 log-probs
+        self.table_q = np.round(self.table * SCORE_SCALE).astype(np.int32)
 
     @staticmethod
     def _build_table() -> np.ndarray:
@@ -167,30 +174,28 @@ class LangIdModel:
         logp = np.log((counts + alpha) / (totals + alpha * TABLE_SIZE))
         return logp.astype(np.float32)
 
-    def scores(self, text: str) -> Optional[Tuple[np.ndarray, int]]:
-        """(total per-language log-likelihood, trigram count), or None for
-        letterless text."""
+    def scores_q(self, text: str) -> Optional[Tuple[np.ndarray, int]]:
+        """(int32 millinat score totals ``[n_langs]``, trigram count), or None
+        for letterless text.  Integer sums — the device kernel computes the
+        same values exactly (:mod:`textblaster_tpu.ops.langid_tpu`)."""
         cps = _normalize_codepoints(text)
         if len(cps) < 3:
             return None
         arr = np.asarray(cps, dtype=np.int64)
         h = (arr[:-2] * 961 + arr[1:-1] * 31 + arr[2:]) & (TABLE_SIZE - 1)
-        return self.table[h].sum(axis=0, dtype=np.float64), len(h)
+        return self.table_q[h].sum(axis=0, dtype=np.int64), len(h)
 
-    def detect(self, text: str) -> Optional[Tuple[str, float]]:
-        """(language display name, confidence) of the best candidate.
+    @staticmethod
+    def decide(scores_q: np.ndarray, n_grams: int) -> Tuple[str, float]:
+        """(language display name, confidence) from quantized score totals.
 
-        Confidence is the softmax probability of the winning language over the
-        candidate set, computed on *length-normalized* log-likelihoods scaled
-        back by a bounded evidence factor — short texts stay uncertain, long
-        unambiguous texts approach 1.0, mirroring lingua's behavior.
+        Confidence is the softmax probability of the winner over the candidate
+        set, on length-normalized log-likelihoods re-scaled by a bounded
+        evidence factor — short texts stay uncertain, long unambiguous texts
+        approach 1.0, mirroring lingua's behavior.
         """
-        scored = self.scores(text)
-        if scored is None:
-            return None
-        s, n_grams = scored
         n_grams = max(n_grams, 1)
-        # Average per-trigram margin, re-scaled by bounded evidence size.
+        s = scores_q.astype(np.float64) / SCORE_SCALE
         evidence = min(float(n_grams), 400.0)
         z = (s / n_grams) * evidence
         z = z - z.max()
@@ -198,6 +203,12 @@ class LangIdModel:
         p /= p.sum()
         best = int(p.argmax())
         return LANGUAGES[best], float(p[best])
+
+    def detect(self, text: str) -> Optional[Tuple[str, float]]:
+        scored = self.scores_q(text)
+        if scored is None:
+            return None
+        return self.decide(*scored)
 
 
 _MODEL: Optional[LangIdModel] = None

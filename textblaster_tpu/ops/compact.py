@@ -1,0 +1,44 @@
+"""Device-side compaction: masked gather into a fresh packed tensor.
+
+The reference's C4 filter physically rewrites document strings (drops lines,
+removes citation spans, rejoins — c4_filters.rs:195-258).  On device the same
+effect is a *compaction*: given a keep-mask over ``[B, L]`` codepoints,
+scatter the kept chars to the front of a new ``[B, L]`` tensor and recompute
+lengths.  Downstream filter kernels then run on the compacted batch exactly as
+they would on any packed batch — sequential pipeline semantics preserved
+without leaving the device (SURVEY.md §7 "content rewriting" hard part).
+
+Also used by the language-ID kernel to build its normalized
+letters-and-boundaries stream.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["compact"]
+
+
+def compact(cps: jax.Array, keep: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Pack kept chars to the row starts.
+
+    Args:
+      cps:  ``[B, L]`` int32 codepoints.
+      keep: ``[B, L]`` bool; True chars survive, order preserved.
+
+    Returns:
+      ``(new_cps [B, L] int32 zero-padded, new_lengths [B] int32)``.
+    """
+    b, length = cps.shape
+    new_pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    new_lengths = jnp.max(jnp.where(keep, new_pos + 1, 0), axis=1)
+
+    # Flat scatter; dropped chars route to a trash slot past the real data.
+    rows = jnp.arange(b, dtype=jnp.int32)[:, None]
+    flat_idx = jnp.where(keep, rows * length + new_pos, b * length)
+    out = jnp.zeros(b * length + 1, dtype=cps.dtype)
+    out = out.at[flat_idx.reshape(-1)].set(cps.reshape(-1), mode="drop")
+    return out[:-1].reshape(b, length), new_lengths.astype(jnp.int32)

@@ -1,0 +1,179 @@
+"""Shared device primitives: class tables, segmented scans, hashing.
+
+These are the building blocks of every filter kernel (SURVEY.md §7 stage 2):
+a byte-class precompute (here: codepoint-class gather over the same table the
+host oracle uses, so host and device classify identically), segmented
+associative scans for per-word / per-line / per-paragraph aggregates, and
+rolling hashes for duplicate detection.
+
+All kernels operate on ``[B, L]`` codepoint tensors with a validity mask;
+reductions are along axis 1.  Scans use ``jax.lax.associative_scan``, which
+XLA lowers to log-depth work-efficient trees on the VPU.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import chartables as ct
+from ..utils.text import _MID_ALL, _MID_LETTER, _MID_NUM, _MID_NUM_LET
+
+__all__ = [
+    "class_table",
+    "lower_table",
+    "classify",
+    "utf8_width",
+    "isin_sorted",
+    "seg_scan_add",
+    "seg_scan_or",
+    "seg_scan_max",
+    "rev",
+    "ALNUM",
+    "ALPHA",
+    "DIGIT",
+    "WS",
+    "PUNCT",
+    "LOWER",
+    "UPPER",
+    "MID_LETTER_CPS",
+    "MID_NUM_CPS",
+    "MID_ALL_CPS",
+    "word_mask",
+    "HASH_MUL",
+]
+
+ALNUM = ct.ALNUM
+ALPHA = ct.ALPHA
+DIGIT = ct.DIGIT
+WS = ct.WS
+PUNCT = ct.PUNCT
+LOWER = ct.LOWER
+UPPER = ct.UPPER
+
+HASH_MUL = np.int32(31)  # polynomial rolling-hash multiplier (int32 wraparound)
+
+
+@lru_cache(maxsize=1)
+def _class_table_np() -> np.ndarray:
+    return ct.char_table()
+
+
+@lru_cache(maxsize=1)
+def _lower_table_np() -> np.ndarray:
+    table = np.arange(ct._MAX_CP, dtype=np.int32)
+    for cp in range(ct._MAX_CP):
+        low = chr(cp).lower()
+        if len(low) == 1 and ord(low) < ct._MAX_CP:
+            table[cp] = ord(low)
+    return table
+
+
+def class_table() -> jax.Array:
+    """The host classification table (``[0x40000] uint8``).  Materialized per
+    trace as an XLA constant (cached host-side; never cache traced arrays)."""
+    return jnp.asarray(_class_table_np())
+
+
+def lower_table() -> jax.Array:
+    """Codepoint -> lowercase codepoint (identity where ``str.lower`` is not
+    a single char).  ``[0x40000] int32``."""
+    return jnp.asarray(_lower_table_np())
+
+
+def classify(cps: jax.Array) -> jax.Array:
+    """Gather char classes; indices clipped like the host ``classify``."""
+    return class_table()[jnp.minimum(cps, ct._MAX_CP - 1)]
+
+
+def utf8_width(cps: jax.Array) -> jax.Array:
+    """UTF-8 encoded byte width of each codepoint (1/2/3/4) — recovers the
+    reference's byte-length semantics (text.rs:203,230,252) from codepoints."""
+    w = jnp.where(cps < 0x80, 1, jnp.where(cps < 0x800, 2, jnp.where(cps < 0x10000, 3, 4)))
+    return w.astype(jnp.int32)
+
+
+def isin_sorted(cps: jax.Array, sorted_vals: jax.Array) -> jax.Array:
+    """Membership of each element in a small sorted codepoint set."""
+    idx = jnp.searchsorted(sorted_vals, cps)
+    idx = jnp.minimum(idx, sorted_vals.shape[0] - 1)
+    return sorted_vals[idx] == cps
+
+
+MID_LETTER_CPS = jnp.asarray(
+    np.sort(np.array([ord(c) for c in (_MID_LETTER | _MID_NUM_LET)], dtype=np.int32))
+)
+MID_NUM_CPS = jnp.asarray(
+    np.sort(np.array([ord(c) for c in (_MID_NUM | _MID_NUM_LET)], dtype=np.int32))
+)
+MID_ALL_CPS = jnp.asarray(
+    np.sort(np.array([ord(c) for c in _MID_ALL], dtype=np.int32))
+)
+
+
+# --- Segmented scans ---------------------------------------------------------
+# State (v, r): r = "resets here".  Composition is the standard segmented-scan
+# monoid; associative, so lax.associative_scan applies.
+
+
+def _seg_add_op(a, b):
+    av, ar = a
+    bv, br = b
+    return jnp.where(br, bv, av + bv), ar | br
+
+
+def _seg_or_op(a, b):
+    av, ar = a
+    bv, br = b
+    return jnp.where(br, bv, av | bv), ar | br
+
+
+def _seg_max_op(a, b):
+    av, ar = a
+    bv, br = b
+    return jnp.where(br, bv, jnp.maximum(av, bv)), ar | br
+
+
+def seg_scan_add(values: jax.Array, reset: jax.Array, axis: int = 1) -> jax.Array:
+    """Inclusive segmented sum along ``axis``; ``reset[i]`` starts a segment."""
+    out, _ = jax.lax.associative_scan(_seg_add_op, (values, reset), axis=axis)
+    return out
+
+
+def seg_scan_or(values: jax.Array, reset: jax.Array, axis: int = 1) -> jax.Array:
+    out, _ = jax.lax.associative_scan(_seg_or_op, (values, reset), axis=axis)
+    return out
+
+
+def seg_scan_max(values: jax.Array, reset: jax.Array, axis: int = 1) -> jax.Array:
+    out, _ = jax.lax.associative_scan(_seg_max_op, (values, reset), axis=axis)
+    return out
+
+
+def rev(x: jax.Array, axis: int = 1) -> jax.Array:
+    return jnp.flip(x, axis=axis)
+
+
+def word_mask(cps: jax.Array, cls: jax.Array) -> jax.Array:
+    """In-word mask — the device twin of ``utils.text._word_mask``.
+
+    A char is in a word if alphanumeric/underscore, or a UAX#29-lite mid
+    character flanked by the right neighbor classes.
+    """
+    word = ((cls & ALNUM) != 0) | (cps == ord("_"))
+    prev_cls = jnp.pad(cls[:, :-1], ((0, 0), (1, 0)))
+    next_cls = jnp.pad(cls[:, 1:], ((0, 0), (0, 1)))
+    letter_ok = (
+        isin_sorted(cps, MID_LETTER_CPS)
+        & ((prev_cls & ALPHA) != 0)
+        & ((next_cls & ALPHA) != 0)
+    )
+    num_ok = (
+        isin_sorted(cps, MID_NUM_CPS)
+        & ((prev_cls & DIGIT) != 0)
+        & ((next_cls & DIGIT) != 0)
+    )
+    return word | letter_ok | num_ok

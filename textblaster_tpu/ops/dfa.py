@@ -1,0 +1,106 @@
+"""Small DFAs over codepoint rows via associative function composition.
+
+The reference's regexes on the hot path (the citation pattern
+``\\[\\d+(?:,\\s*\\d+)*\\]``, c4_filters.rs:33; the sentence-boundary rules)
+become tiny DFAs here.  A DFA step is a gather through a per-char transition
+row; runs of steps compose associatively (``t_ab = t_b[t_a]``), so the whole
+row is evaluated with ``lax.associative_scan`` in log depth — no sequential
+scan, XLA-friendly (SURVEY.md §7 "regexes on device").
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["dfa_states", "citation_spans"]
+
+
+def dfa_states(
+    char_classes: jax.Array, transition: np.ndarray, start_state: int = 0
+) -> jax.Array:
+    """Inclusive per-position DFA state along axis 1.
+
+    Args:
+      char_classes: ``[B, L] int32`` — per-char input symbol in ``[0, S)``.
+      transition:   ``[S, N] -> N`` numpy table: next state per (symbol, state).
+      start_state:  initial state before position 0.
+
+    Returns:
+      ``[B, L] int32`` — state *after* consuming each char.
+    """
+    table = jnp.asarray(transition, dtype=jnp.int32)  # [S, N]
+    # Per-char transition row: f_i : state -> state, shape [B, L, N].
+    fns = table[char_classes]
+
+    def compose(a, b):
+        # Apply a then b: (b . a)(s) = b[a[s]].
+        return jnp.take_along_axis(b, a, axis=-1)
+
+    composed = jax.lax.associative_scan(compose, fns, axis=1)
+    return composed[..., start_state]
+
+
+# Citation DFA symbols: 0=other, 1='[', 2=digit, 3=',', 4=space, 5=']'.
+# States: 0=dead/outside, 1=after '[', 2=in digits, 3=after comma (spaces ok),
+# 4=accept (just consumed ']' after digits).
+_CIT_N = 5
+_CIT_T = np.zeros((6, _CIT_N), dtype=np.int32)
+# other: kill any progress
+_CIT_T[0, :] = 0
+# '[': always (re)start a candidate
+_CIT_T[1, :] = 1
+# digit: valid after '[', digit, comma-space; else dead
+_CIT_T[2, :] = [0, 2, 2, 2, 0]
+# ',': valid within digits
+_CIT_T[3, :] = [0, 0, 3, 0, 0]
+# space: valid after comma (\s* between comma and digits)
+_CIT_T[4, :] = [0, 0, 0, 3, 0]
+# ']': accept after >=1 digit
+_CIT_T[5, :] = [0, 0, 4, 0, 0]
+
+
+def citation_spans(cps: jax.Array, digit_mask: jax.Array, ws_mask: jax.Array) -> jax.Array:
+    """Deletion mask for Wikipedia-style citations ``[1]``, ``[2, 3]``.
+
+    Matches the reference regex ``\\[\\d+(?:,\\s*\\d+)*\\]`` over each row and
+    returns a ``[B, L] bool`` mask marking every char inside a match
+    (brackets included).
+
+    ``\\s`` here is the regex-semantics whitespace of the reference engine
+    (Unicode White_Space), supplied by ``ws_mask``.
+    """
+    sym = jnp.zeros_like(cps)
+    sym = jnp.where(digit_mask, 2, sym)
+    sym = jnp.where(cps == ord("["), 1, sym)
+    sym = jnp.where(cps == ord(","), 3, sym)
+    sym = jnp.where(ws_mask & (sym == 0), 4, sym)
+    sym = jnp.where(cps == ord("]"), 5, sym)
+
+    states = dfa_states(sym, _CIT_T)
+    accept = states == 4  # position of each closing ']'
+
+    # Span start = the most recent '[' (inside a match no other '[' occurs,
+    # because '[' resets the candidate — so the nearest preceding '[' is the
+    # match opener).  Mark spans with a +1/-1 difference array and a cumsum.
+    positions = jnp.arange(cps.shape[1], dtype=jnp.int32)[None, :]
+    lb_pos = jnp.where(cps == ord("["), positions, -1)
+    last_lb = jax.lax.associative_scan(jnp.maximum, lb_pos, axis=1)
+
+    b, length = cps.shape
+    rows = jnp.arange(b, dtype=jnp.int32)[:, None]
+    starts = jnp.where(accept, last_lb, -1)
+
+    diff = jnp.zeros((b, length + 1), dtype=jnp.int32)
+    flat_start = jnp.where(accept, rows * (length + 1) + starts, b * (length + 1))
+    flat_end = jnp.where(accept, rows * (length + 1) + positions + 1, b * (length + 1))
+    flat = jnp.zeros(b * (length + 1) + 1, dtype=jnp.int32)
+    flat = flat.at[flat_start.reshape(-1)].add(
+        jnp.where(accept, 1, 0).reshape(-1), mode="drop"
+    )
+    flat = flat.at[flat_end.reshape(-1)].add(
+        jnp.where(accept, -1, 0).reshape(-1), mode="drop"
+    )
+    diff = flat[:-1].reshape(b, length + 1)
+    return jnp.cumsum(diff[:, :length], axis=1) > 0

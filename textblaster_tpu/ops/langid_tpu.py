@@ -1,0 +1,83 @@
+"""Language-ID device kernel.
+
+The device twin of :class:`textblaster_tpu.models.langid.LangIdModel`: build
+the normalized letters-and-boundaries stream with a compaction, hash trigrams,
+gather the quantized log-prob table, and sum int32 scores per document.
+Integer accumulation makes the scores *bit-identical* to the host model —
+confidence/decision logic runs host-side from the same numbers.
+
+This is the one dense "model" in the system (SURVEY.md §7 item 5): scoring is
+a ``[65536, 5]`` embedding-style gather + segmented reduction, which XLA maps
+onto the TPU's vector unit with the table resident in HBM.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.langid import TABLE_SIZE, get_model
+from .compact import compact
+from .device import ALPHA, classify, lower_table
+from .stats import _shift_r
+
+__all__ = ["langid_scores"]
+
+
+def _table_q() -> jax.Array:
+    return jnp.asarray(get_model().table_q)  # [TABLE_SIZE, 5] int32
+
+
+def langid_scores(cps: jax.Array, lengths: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Per-document quantized language scores.
+
+    Returns ``(scores_q [B, 5] int32, n_grams [B] int32)``; rows with
+    ``n_grams == 0`` are undetectable (letterless).
+    """
+    _, length = cps.shape
+    mask = jnp.arange(length, dtype=jnp.int32)[None, :] < lengths[:, None]
+
+    lt = lower_table()
+    low = jnp.where(mask, lt[jnp.minimum(cps, lt.shape[0] - 1)], 0)
+    letter = ((classify(low) & ALPHA) != 0) & mask
+
+    # Collapse non-letter runs to single boundary markers (value 0), keeping
+    # the first char of each run; wrap the stream in boundaries like the host
+    # _normalize_codepoints.
+    nonletter = mask & ~letter
+    first_of_run = nonletter & ~_shift_r(nonletter, False)
+    keep = letter | first_of_run
+    vals = jnp.where(letter, low, 0)
+    norm, nlen = compact(vals, keep)
+
+    # Leading boundary: prepend 0 unless the stream already starts with one.
+    starts_with_letter = norm[:, 0] != 0
+    shifted = jnp.concatenate([jnp.zeros_like(norm[:, :1]), norm[:, :-1]], axis=1)
+    norm = jnp.where(starts_with_letter[:, None], shifted, norm)
+    nlen = nlen + jnp.where(starts_with_letter & (nlen > 0), 1, 0)
+
+    # Trailing boundary: the padded buffer is already 0, so just extend the
+    # length when the last element is a letter.
+    last = jnp.take_along_axis(
+        norm, jnp.maximum(nlen[:, None] - 1, 0), axis=1
+    )[:, 0]
+    nlen = jnp.minimum(
+        nlen + jnp.where((last != 0) & (nlen > 0), 1, 0), jnp.int32(length)
+    )
+
+    c1 = norm
+    c2 = jnp.concatenate([norm[:, 1:], jnp.zeros_like(norm[:, :1])], axis=1)
+    c3 = jnp.concatenate([norm[:, 2:], jnp.zeros_like(norm[:, :2])], axis=1)
+    h = (c1 * 961 + c2 * 31 + c3) & (TABLE_SIZE - 1)
+
+    tri_valid = (
+        jnp.arange(length, dtype=jnp.int32)[None, :] < jnp.maximum(nlen - 2, 0)[:, None]
+    )
+    rows = _table_q()[h]  # [B, L, 5]
+    scores = jnp.sum(
+        jnp.where(tri_valid[..., None], rows, 0), axis=1, dtype=jnp.int32
+    )
+    n_grams = jnp.maximum(nlen - 2, 0).astype(jnp.int32)
+    return scores, n_grams

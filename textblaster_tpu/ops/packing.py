@@ -1,0 +1,136 @@
+"""Packed document batches for device execution.
+
+The device-side document store (SURVEY.md §7 stage 1): a batch of documents
+becomes one dense ``[B, L] int32`` codepoint tensor plus per-document lengths.
+Codepoints (UTF-32) rather than UTF-8 bytes are the device representation:
+every filter decision is defined over *characters* (char classes, char
+counts), so decoding once on the host (a single C-speed ``str.encode``) keeps
+the kernels branch-free; the reference's byte-length quirks are recovered on
+device from the codepoint values (1/2/3/4-byte UTF-8 width is a pure function
+of the codepoint).
+
+Batches are length-bucketed into a small set of static shapes so XLA compiles
+one program per bucket (SURVEY.md §5 "ragged data on fixed shapes").
+Documents longer than the largest bucket are flagged for the host fallback
+path rather than truncated.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..data_model import TextDocument
+
+__all__ = ["PackedBatch", "DEFAULT_BUCKETS", "pack_documents", "iter_packed_batches"]
+
+# Bucket char capacities.  Most CC documents are < 8k chars; the tail gets the
+# big bucket and true outliers (>64k chars) fall back to the host oracle.
+DEFAULT_BUCKETS: Tuple[int, ...] = (512, 2048, 8192, 32768, 65536)
+
+
+@dataclass
+class PackedBatch:
+    """One fixed-shape device batch.
+
+    ``cps``    — ``[B, L] int32`` codepoints, zero-padded past ``lengths``.
+    ``lengths`` — ``[B] int32`` document char counts.
+    ``valid``  — ``[B] bool``; False rows are padding documents.
+    ``docs``   — the host-side documents, index-aligned with rows.
+    """
+
+    cps: np.ndarray
+    lengths: np.ndarray
+    valid: np.ndarray
+    docs: List[TextDocument]
+
+    @property
+    def batch_size(self) -> int:
+        return self.cps.shape[0]
+
+    @property
+    def max_len(self) -> int:
+        return self.cps.shape[1]
+
+
+def _encode(text: str) -> np.ndarray:
+    if not text:
+        return np.empty(0, dtype=np.int32)
+    return np.frombuffer(text.encode("utf-32-le"), dtype=np.uint32).astype(np.int32)
+
+
+def pack_documents(
+    docs: Sequence[TextDocument],
+    batch_size: int,
+    max_len: int,
+) -> PackedBatch:
+    """Pack documents into one ``[batch_size, max_len]`` tensor.
+
+    Rows beyond ``len(docs)`` are zero padding with ``valid=False``.  Callers
+    are responsible for routing over-length documents elsewhere.
+    """
+    n = len(docs)
+    assert n <= batch_size
+    cps = np.zeros((batch_size, max_len), dtype=np.int32)
+    lengths = np.zeros(batch_size, dtype=np.int32)
+    valid = np.zeros(batch_size, dtype=bool)
+    for i, doc in enumerate(docs):
+        arr = _encode(doc.content)
+        assert arr.shape[0] <= max_len, "over-length document reached the packer"
+        cps[i, : arr.shape[0]] = arr
+        lengths[i] = arr.shape[0]
+        valid[i] = True
+    return PackedBatch(cps=cps, lengths=lengths, valid=valid, docs=list(docs))
+
+
+def iter_packed_batches(
+    docs: Iterator[TextDocument],
+    batch_size: int = 256,
+    buckets: Sequence[int] = DEFAULT_BUCKETS,
+) -> Iterator[Tuple[Optional[PackedBatch], List[TextDocument]]]:
+    """Group a document stream into per-bucket batches.
+
+    Yields ``(packed_batch, host_fallback_docs)`` pairs.  Documents longer
+    than the largest bucket are returned in the fallback list (processed by
+    the host oracle); everything else lands in the smallest bucket that fits.
+    A final partial batch per bucket is flushed at stream end.
+    """
+    buckets = tuple(sorted(buckets))
+    # Kernels need a little headroom past the content (e.g. the language-ID
+    # stream wraps the text in boundary markers), so a bucket admits documents
+    # only up to 4 chars below its capacity.
+    margin = 4
+    largest = buckets[-1] - margin
+    pending: dict[int, List[TextDocument]] = {b: [] for b in buckets}
+    overflow: List[TextDocument] = []
+
+    def flush(bucket: int) -> Optional[PackedBatch]:
+        batch_docs = pending[bucket]
+        if not batch_docs:
+            return None
+        pending[bucket] = []
+        return pack_documents(batch_docs, batch_size=batch_size, max_len=bucket)
+
+    for doc in docs:
+        n_chars = len(doc.content)
+        if n_chars > largest:
+            overflow.append(doc)
+            if len(overflow) >= 64:
+                yield None, overflow
+                overflow = []
+            continue
+        for b in buckets:
+            if n_chars <= b - margin:
+                pending[b].append(doc)
+                if len(pending[b]) >= batch_size:
+                    yield flush(b), []
+                break
+
+    for b in buckets:
+        batch = flush(b)
+        if batch is not None:
+            yield batch, []
+    if overflow:
+        yield None, overflow

@@ -1,0 +1,683 @@
+"""The compiled filter pipeline: config -> one XLA program per shape bucket.
+
+This is the device replacement for the reference's executor + worker loop
+(SURVEY.md §7 stage 3): the whole filter chain is traced once into a single
+``jit`` function mapping a packed batch to per-filter integer statistics.
+Sequential observable semantics (a doc filtered at step k gets no step-k+1
+metadata; C4's rewrite feeds downstream steps) are preserved by:
+
+* computing every step's stats unconditionally on device (masked work is
+  free compared to divergent control flow — XLA semantics), and
+* resolving order, short-circuiting, metadata stamping, and reason-string
+  formatting on the host from the integer stats, with float64 arithmetic
+  identical to the oracle filters'.
+
+Steps with no device kernel (TokenCounter, C4BadWordsFilter, C4 in
+sentence-split mode) run as host oracle steps.  If they appear as a suffix
+of the config, the device prefix still runs compiled; any other placement
+falls back to the host executor for the whole pipeline.  Documents that
+overflow kernel table bounds (pathological line/word counts) are re-run on
+the host oracle — the outlier path SURVEY.md §5 calls for.
+"""
+
+from __future__ import annotations
+
+import logging
+from functools import partial
+from typing import Callable, Dict, Iterable, Iterator, List, Optional, Tuple, Union
+
+import jax
+import numpy as np
+
+from ..config.pipeline import PipelineConfig, StepConfig
+from ..data_model import ProcessingOutcome, TextDocument
+from ..errors import PipelineError
+from ..filters.c4_quality import CITATION_RE
+from ..filters.common import fmt2, fmt4, rust_bool, rust_float, rust_lines
+from ..filters.gopher_quality import DEFAULT_STOP_WORDS
+from ..filters.fineweb_quality import DEFAULT_STOP_CHARS
+from ..models.langid import ISO_TO_NAME, NAME_TO_ISO, LangIdModel
+from ..orchestration import execute_processing_pipeline
+from ..pipeline_builder import build_pipeline_from_config
+from .langid_tpu import langid_scores
+from .packing import DEFAULT_BUCKETS, PackedBatch, iter_packed_batches
+from .stats import (
+    C4Params,
+    c4_stage,
+    fineweb_stats,
+    gopher_quality_stats,
+    gopher_rep_stats,
+    hash_string,
+    structure,
+)
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["CompiledPipeline", "process_documents_device", "device_step_types"]
+
+_DEVICE_STEPS = {
+    "LanguageDetectionFilter",
+    "GopherRepetitionFilter",
+    "GopherQualityFilter",
+    "C4QualityFilter",
+    "FineWebQualityFilter",
+}
+
+
+def device_step_types() -> frozenset:
+    return frozenset(_DEVICE_STEPS)
+
+
+def _step_on_device(step: StepConfig) -> bool:
+    if step.type not in _DEVICE_STEPS:
+        return False
+    if step.type == "C4QualityFilter" and not step.params.split_paragraph:
+        return False
+    return True
+
+
+def _table_sizes(length: int) -> Tuple[int, int]:
+    """(max line/para slots, max word slots) for a bucket of ``length``."""
+    max_lines = min(length, max(128, length // 8))
+    max_words = min(16384, max(256, length // 2))
+    return max_lines, max_words
+
+
+class _Decision:
+    """Host-side result for one step on one doc."""
+
+    __slots__ = ("passed", "reason", "stamps", "extra")
+
+    def __init__(self, passed: bool, reason: str = "", stamps=None, extra=None):
+        self.passed = passed
+        self.reason = reason
+        self.stamps = stamps or []  # list[(key, value)] in stamp order
+        self.extra = extra
+
+
+class CompiledPipeline:
+    """A pipeline config compiled for device execution."""
+
+    def __init__(
+        self,
+        config: PipelineConfig,
+        buckets=DEFAULT_BUCKETS,
+        batch_size: int = 256,
+        mesh=None,
+    ) -> None:
+        self.config = config
+        self.buckets = tuple(sorted(buckets))
+        self.mesh = mesh
+        if mesh is not None:
+            n_dev = mesh.devices.size
+            batch_size = max(n_dev, (batch_size // n_dev) * n_dev)
+        self.batch_size = batch_size
+
+        steps = list(config.pipeline)
+        n_device = 0
+        for s in steps:
+            if _step_on_device(s):
+                n_device += 1
+            else:
+                break
+        self.device_steps = steps[:n_device]
+        self.host_steps = steps[n_device:]
+        # Host-only fallback when un-kerneled steps precede device steps.
+        self.fully_host = any(_step_on_device(s) for s in self.host_steps)
+
+        self._host_executor = None
+        self._host_suffix_executor = None
+        self._jitted: Dict[int, Callable] = {}
+        self._langid = LangIdModel()
+
+    # --- host executors -----------------------------------------------------
+
+    @property
+    def host_executor(self):
+        if self._host_executor is None:
+            self._host_executor = build_pipeline_from_config(self.config)
+        return self._host_executor
+
+    @property
+    def host_suffix_executor(self):
+        if self._host_suffix_executor is None:
+            from ..executor import PipelineExecutor
+            from ..pipeline_builder import build_step
+
+            self._host_suffix_executor = PipelineExecutor(
+                [build_step(s) for s in self.host_steps]
+            )
+        return self._host_suffix_executor
+
+    # --- device program -----------------------------------------------------
+
+    def _build_fn(self, length: int) -> Callable:
+        max_lines, max_words = _table_sizes(length)
+        plans = []
+        for i, step in enumerate(self.device_steps):
+            p = step.params
+            if step.type == "LanguageDetectionFilter":
+                plans.append(("langid", i, None))
+            elif step.type == "GopherQualityFilter":
+                stop_words = (
+                    p.stop_words if p.stop_words is not None else list(DEFAULT_STOP_WORDS)
+                )
+                hashes = tuple(sorted({hash_string(w) for w in stop_words}))
+                plans.append(("gopher_quality", i, hashes))
+            elif step.type == "GopherRepetitionFilter":
+                plans.append(
+                    (
+                        "gopher_rep",
+                        i,
+                        (
+                            tuple(n for n, _ in p.top_n_grams),
+                            tuple(n for n, _ in p.dup_n_grams),
+                        ),
+                    )
+                )
+            elif step.type == "C4QualityFilter":
+                plans.append(
+                    (
+                        "c4",
+                        i,
+                        C4Params(
+                            split_paragraph=p.split_paragraph,
+                            remove_citations=p.remove_citations,
+                            filter_no_terminal_punct=p.filter_no_terminal_punct,
+                            min_num_sentences=p.min_num_sentences,
+                            min_words_per_line=p.min_words_per_line,
+                            max_word_length=p.max_word_length,
+                            filter_lorem_ipsum=p.filter_lorem_ipsum,
+                            filter_javascript=p.filter_javascript,
+                            filter_curly_bracket=p.filter_curly_bracket,
+                            filter_policy=p.filter_policy,
+                        ),
+                    )
+                )
+            elif step.type == "FineWebQualityFilter":
+                stop_chars = (
+                    tuple(sorted(p.stop_chars))
+                    if p.stop_chars is not None
+                    else tuple(sorted(DEFAULT_STOP_CHARS))
+                )
+                plans.append(("fineweb", i, stop_chars))
+
+        def fn(cps, lengths):
+            out: Dict[str, jax.Array] = {}
+            state = {"cps": cps, "lengths": lengths, "st": None}
+
+            def get_structure():
+                if state["st"] is None:
+                    state["st"] = structure(state["cps"], state["lengths"])
+                return state["st"]
+
+            for kind, i, arg in plans:
+                if kind == "langid":
+                    scores, n_grams = langid_scores(state["cps"], state["lengths"])
+                    out[f"{i}:scores"] = scores
+                    out[f"{i}:n_grams"] = n_grams
+                elif kind == "gopher_quality":
+                    for k, v in gopher_quality_stats(get_structure(), arg).items():
+                        out[f"{i}:{k}"] = v
+                elif kind == "gopher_rep":
+                    top_ns, dup_ns = arg
+                    stats = gopher_rep_stats(
+                        get_structure(), top_ns, dup_ns, max_lines, max_words
+                    )
+                    for k, v in stats.items():
+                        out[f"{i}:{k}"] = v
+                elif kind == "c4":
+                    stats, new_cps, new_lengths = c4_stage(
+                        state["cps"], state["lengths"], arg, max_lines
+                    )
+                    for k, v in stats.items():
+                        out[f"{i}:{k}"] = v
+                    # Downstream steps see the rewritten batch (sequential
+                    # pipeline semantics — executor.rs:30-57 analogue).
+                    state.update(cps=new_cps, lengths=new_lengths, st=None)
+                elif kind == "fineweb":
+                    for k, v in fineweb_stats(get_structure(), arg, max_lines).items():
+                        out[f"{i}:{k}"] = v
+            return out
+
+        if self.mesh is not None:
+            from ..parallel.mesh import batch_sharding
+
+            return jax.jit(
+                fn,
+                in_shardings=(
+                    batch_sharding(self.mesh, 2),
+                    batch_sharding(self.mesh, 1),
+                ),
+            )
+        return jax.jit(fn)
+
+    def _fn_for(self, length: int) -> Callable:
+        if length not in self._jitted:
+            self._jitted[length] = self._build_fn(length)
+        return self._jitted[length]
+
+    # --- host finalizers ----------------------------------------------------
+
+    def _finalize_step(
+        self, step: StepConfig, idx: int, stats: Dict[str, np.ndarray], row: int,
+        doc: TextDocument,
+    ) -> Tuple[_Decision, bool]:
+        """(decision, overflowed) for one step on one row."""
+        g = lambda key: stats[f"{idx}:{key}"][row]  # noqa: E731
+        p = step.params
+
+        if step.type == "LanguageDetectionFilter":
+            n_grams = int(g("n_grams"))
+            if n_grams <= 0:
+                return _Decision(False, "Language could not be confidently detected"), False
+            lang, conf = self._langid.decide(np.asarray(stats[f"{idx}:scores"][row]), n_grams)
+            stamps = [
+                ("Detected language", lang),
+                ("Detected language confidence", rust_float(conf)),
+            ]
+            allowed = [c for c in p.allowed_languages if c in ISO_TO_NAME]
+            if NAME_TO_ISO[lang] not in allowed:
+                joined = "; ".join(allowed)
+                return (
+                    _Decision(
+                        False,
+                        f'Document is not any of the following languages: "{joined}"',
+                        stamps,
+                    ),
+                    False,
+                )
+            if conf < p.min_confidence:
+                return (
+                    _Decision(
+                        False,
+                        "Language detection confidence is not satified: "
+                        f"{rust_float(conf)} < {rust_float(p.min_confidence)}",
+                        stamps,
+                    ),
+                    False,
+                )
+            return _Decision(True, stamps=stamps), False
+
+        if step.type == "GopherRepetitionFilter":
+            overflow = bool(g("seg_overflow")) or bool(g("word_overflow"))
+            if overflow:
+                return _Decision(True), True
+            trimmed_len = int(g("trimmed_len"))
+            if trimmed_len == 0:
+                return (
+                    _Decision(
+                        False,
+                        "skipping empty content",
+                        [
+                            ("gopher_repetition_filter_status", "filtered"),
+                            ("gopher_repetition_filter_reason", "skipping empty content"),
+                        ],
+                    ),
+                    False,
+                )
+            text_char_len = float(max(trimmed_len, 1))
+            reasons: List[str] = []
+            ratio = int(g("para_dup_elems")) / max(int(g("n_paragraphs")), 1)
+            if p.dup_para_frac is not None and ratio > p.dup_para_frac:
+                reasons.append(
+                    f"dup_para_frac (ratio {fmt2(ratio)}, max {fmt2(p.dup_para_frac)})"
+                )
+            ratio = int(g("para_dup_bytes")) / text_char_len
+            if p.dup_para_char_frac is not None and ratio > p.dup_para_char_frac:
+                reasons.append(
+                    f"dup_para_char_frac (ratio {fmt2(ratio)}, "
+                    f"max {fmt2(p.dup_para_char_frac)})"
+                )
+            ratio = int(g("line_dup_elems")) / max(int(g("n_lines")), 1)
+            if p.dup_line_frac is not None and ratio > p.dup_line_frac:
+                reasons.append(
+                    f"dup_line_frac (ratio {fmt2(ratio)}, max {fmt2(p.dup_line_frac)})"
+                )
+            ratio = int(g("line_dup_bytes")) / text_char_len
+            if p.dup_line_char_frac is not None and ratio > p.dup_line_char_frac:
+                reasons.append(
+                    f"dup_line_char_frac (ratio {fmt2(ratio)}, "
+                    f"max {fmt2(p.dup_line_char_frac)})"
+                )
+            for n, thr in p.top_n_grams:
+                ratio = int(g(f"top_{n}")) / text_char_len
+                if n > 0 and ratio > thr:
+                    reasons.append(f"top_{n}_gram (ratio {fmt2(ratio)}, max {fmt2(thr)})")
+            for n, thr in p.dup_n_grams:
+                ratio = int(g(f"dup_{n}")) / text_char_len
+                if n > 0 and ratio > thr:
+                    reasons.append(
+                        f"duplicated_{n}_n_grams (ratio {fmt2(ratio)}, max {fmt2(thr)})"
+                    )
+            if reasons:
+                rs = "; ".join(reasons)
+                return (
+                    _Decision(
+                        False,
+                        rs,
+                        [
+                            ("gopher_repetition_filter_status", "filtered"),
+                            ("gopher_repetition_filter_reasons", rs),
+                        ],
+                    ),
+                    False,
+                )
+            return (
+                _Decision(True, stamps=[("gopher_repetition_filter_status", "passed")]),
+                False,
+            )
+
+        if step.type == "GopherQualityFilter":
+            n_non_symbol = int(g("n_non_symbol"))
+            n_words = int(g("n_words"))
+            sum_len = int(g("sum_word_len"))
+            avg = sum_len / n_non_symbol if n_non_symbol else 0.0
+            n_total_calc = float(max(n_words, 1))
+            hash_ratio = int(g("hash_count")) / n_total_calc
+            ellipsis_ratio = int(g("ellipsis_units")) / n_total_calc
+            n_lines_calc = float(max(int(g("n_lines")), 1))
+            bullet_ratio = int(g("bullet_lines")) / n_lines_calc
+            ell_lines_ratio = int(g("ellipsis_lines")) / n_lines_calc
+            alpha_ratio = int(g("alpha_words")) / n_total_calc
+            stop_count = int(g("stop_words"))
+
+            reasons = []
+            if p.min_doc_words is not None and n_non_symbol < p.min_doc_words:
+                reasons.append(
+                    f"gopher_short_doc ({n_non_symbol} non-symbol words, "
+                    f"required {p.min_doc_words})"
+                )
+            if p.max_doc_words is not None and n_non_symbol > p.max_doc_words:
+                reasons.append(
+                    f"gopher_long_doc ({n_non_symbol} non-symbol words, "
+                    f"max {p.max_doc_words})"
+                )
+            if p.min_avg_word_length is not None and avg < p.min_avg_word_length:
+                suffix = (
+                    " - 0 non-symbol words"
+                    if n_non_symbol == 0 and p.min_avg_word_length > 0.0
+                    else ""
+                )
+                reasons.append(
+                    f"gopher_below_avg_threshold (avg len {fmt2(avg)}, "
+                    f"required {fmt2(p.min_avg_word_length)}{suffix})"
+                )
+            if (
+                p.max_avg_word_length is not None
+                and n_non_symbol > 0
+                and avg > p.max_avg_word_length
+            ):
+                reasons.append(
+                    f"gopher_above_avg_threshold (avg len {fmt2(avg)}, "
+                    f"max {fmt2(p.max_avg_word_length)})"
+                )
+            if p.max_symbol_word_ratio is not None:
+                if hash_ratio > p.max_symbol_word_ratio:
+                    reasons.append(
+                        f"gopher_too_many_hashes (ratio {fmt2(hash_ratio)}, "
+                        f"max {fmt2(p.max_symbol_word_ratio)})"
+                    )
+                if ellipsis_ratio > p.max_symbol_word_ratio:
+                    reasons.append(
+                        f"gopher_too_many_ellipsis_units (ratio {fmt2(ellipsis_ratio)}, "
+                        f"max {fmt2(p.max_symbol_word_ratio)})"
+                    )
+            if (
+                p.max_bullet_lines_ratio is not None
+                and bullet_ratio > p.max_bullet_lines_ratio
+            ):
+                reasons.append(
+                    f"gopher_too_many_bullets (ratio {fmt2(bullet_ratio)}, "
+                    f"max {fmt2(p.max_bullet_lines_ratio)})"
+                )
+            if (
+                p.max_ellipsis_lines_ratio is not None
+                and ell_lines_ratio > p.max_ellipsis_lines_ratio
+            ):
+                reasons.append(
+                    f"gopher_too_many_end_ellipsis_lines (ratio {fmt2(ell_lines_ratio)}, "
+                    f"max {fmt2(p.max_ellipsis_lines_ratio)})"
+                )
+            if (
+                p.max_non_alpha_words_ratio is not None
+                and alpha_ratio < p.max_non_alpha_words_ratio
+            ):
+                reasons.append(
+                    f"gopher_below_alpha_threshold (alpha ratio {fmt2(alpha_ratio)}, "
+                    f"required min {fmt2(p.max_non_alpha_words_ratio)})"
+                )
+            if (
+                p.min_stop_words is not None
+                and p.min_stop_words > 0
+                and stop_count < p.min_stop_words
+            ):
+                reasons.append(
+                    f"gopher_too_few_stop_words (found {stop_count}, "
+                    f"required {p.min_stop_words})"
+                )
+            if reasons:
+                rs = "; ".join(reasons)
+                return (
+                    _Decision(
+                        False,
+                        rs,
+                        [
+                            ("gopher_quality_filter_status", "filtered"),
+                            ("gopher_quality_filter_reasons", rs),
+                        ],
+                    ),
+                    False,
+                )
+            return (
+                _Decision(True, stamps=[("gopher_quality_filter_status", "passed")]),
+                False,
+            )
+
+        if step.type == "C4QualityFilter":
+            if bool(g("line_overflow")):
+                return _Decision(True), True
+            reasons = []
+            if bool(g("has_lorem")):
+                reasons.append("lorem_ipsum")
+            if bool(g("has_curly")):
+                reasons.append("curly_bracket")
+            if reasons:
+                rs = "; ".join(reasons)
+                return (
+                    _Decision(
+                        False,
+                        rs,
+                        [("c4_filter_status", "filtered"), ("c4_filter_reasons", rs)],
+                        extra={"rewrite": False},
+                    ),
+                    False,
+                )
+            n_sent = int(g("n_sentences"))
+            n_lines = int(g("n_lines"))
+            keep_mask = np.asarray(stats[f"{idx}:line_keep"][row][:n_lines])
+            line_stats = []
+            for key, name in (
+                ("drop_too_long", "line-filter-too_long_word"),
+                ("drop_no_term", "line-filter-no_terminal_punc"),
+                ("drop_few_words", "line-filter-too_few_words"),
+            ):
+                c = int(g(key))
+                if c > 0:
+                    line_stats.append((name, str(c)))
+            extra = {"rewrite": True, "keep_mask": keep_mask}
+            if p.min_num_sentences > 0 and n_sent < p.min_num_sentences:
+                rs = (
+                    f"too_few_sentences (found {n_sent}, "
+                    f"required {p.min_num_sentences})"
+                )
+                stamps = [
+                    ("c4_filter_status", "filtered"),
+                    ("c4_filter_reasons", rs),
+                ] + line_stats
+                return _Decision(False, rs, stamps, extra=extra), False
+            return (
+                _Decision(True, stamps=[("c4_filter_status", "passed")], extra=extra),
+                False,
+            )
+
+        if step.type == "FineWebQualityFilter":
+            if bool(g("line_overflow")):
+                return _Decision(True), True
+            n_lines = int(g("n_nonblank_lines"))
+
+            def fail(reason, outcome_reason=""):
+                return (
+                    _Decision(
+                        False,
+                        outcome_reason or reason,
+                        [
+                            ("fineweb_filter_status", "filtered"),
+                            ("fineweb_filter_reason", reason),
+                        ],
+                    ),
+                    False,
+                )
+
+            if n_lines == 0:
+                return fail("empty document", outcome_reason="empty")
+            ratio = int(g("lines_ending_stop")) / n_lines
+            if ratio < p.line_punct_thr and not (
+                ratio == 0.0 and p.line_punct_exclude_zero
+            ):
+                return fail(
+                    f"line_punct_ratio: {fmt4(ratio)} < threshold "
+                    f"{fmt4(p.line_punct_thr)} (exclude_zero: "
+                    f"{rust_bool(p.line_punct_exclude_zero)})"
+                )
+            line_chars = np.asarray(stats[f"{idx}:line_chars"][row])
+            has_content = np.asarray(stats[f"{idx}:line_has_content"][row])
+            short = int(np.sum(has_content & (line_chars <= p.short_line_length)))
+            ratio = short / n_lines
+            if ratio > p.short_line_thr:
+                return fail(
+                    f"short_line_ratio: {fmt4(ratio)} > threshold "
+                    f"{fmt4(p.short_line_thr)}"
+                )
+            total_chars = int(g("total_chars_no_newline"))
+            dup_ratio = (
+                int(g("dup_line_bytes")) / total_chars if total_chars > 0 else 0.0
+            )
+            if dup_ratio > p.char_duplicates_ratio:
+                return fail(
+                    f"char_dup_ratio: {fmt4(dup_ratio)} > threshold "
+                    f"{fmt4(p.char_duplicates_ratio)}"
+                )
+            n_words = int(g("n_words"))
+            newlines = int(g("newline_count"))
+            if n_words == 0:
+                if newlines > 0:
+                    return fail("list_ratio_no_words (newlines present but no words)")
+            else:
+                ratio = newlines / n_words
+                if ratio > p.new_line_ratio:
+                    return fail(
+                        f"list_ratio: {fmt4(ratio)} > threshold "
+                        f"{fmt4(p.new_line_ratio)}"
+                    )
+            return _Decision(True), False
+
+        raise PipelineError(f"no finalizer for step {step.type}")
+
+    # --- batch processing ---------------------------------------------------
+
+    def _rewrite_c4(self, doc: TextDocument, step: StepConfig, keep_mask) -> None:
+        """Apply the device line-keep mask to rebuild C4's rewritten content —
+        the string half of c4_filters.rs:192-258; decisions came from device."""
+        lines = rust_lines(doc.content)
+        kept = []
+        for i, line in enumerate(lines):
+            if i < len(keep_mask) and keep_mask[i]:
+                s = line.strip()
+                if step.params.remove_citations:
+                    s = CITATION_RE.sub("", s)
+                kept.append(s)
+        doc.content = "\n".join(kept).strip()
+
+    def process_batch(self, batch: PackedBatch) -> List[ProcessingOutcome]:
+        fn = self._fn_for(batch.max_len)
+        if self.mesh is not None:
+            from ..parallel.mesh import shard_batch
+
+            cps, lengths = shard_batch(self.mesh, batch.cps, batch.lengths)
+        else:
+            cps, lengths = batch.cps, batch.lengths
+        device_stats = fn(cps, lengths)
+        stats = {k: np.asarray(v) for k, v in device_stats.items()}
+
+        outcomes: List[ProcessingOutcome] = []
+        for row, doc in enumerate(batch.docs):
+            outcome = self._assemble(stats, row, doc)
+            outcomes.append(outcome)
+        return outcomes
+
+    def _assemble(
+        self, stats: Dict[str, np.ndarray], row: int, doc: TextDocument
+    ) -> ProcessingOutcome:
+        for idx, step in enumerate(self.device_steps):
+            decision, overflowed = self._finalize_step(step, idx, stats, row, doc)
+            if overflowed:
+                # Table overflow: this doc is an outlier — host oracle rerun.
+                return execute_processing_pipeline(self.host_executor, doc)
+            for k, v in decision.stamps:
+                doc.metadata[k] = v
+            if step.type == "C4QualityFilter" and decision.extra is not None:
+                if decision.extra.get("rewrite"):
+                    self._rewrite_c4(doc, step, decision.extra["keep_mask"])
+            if not decision.passed:
+                return ProcessingOutcome.filtered(doc, decision.reason)
+        if self.host_steps:
+            return execute_processing_pipeline(self.host_suffix_executor, doc)
+        return ProcessingOutcome.success(doc)
+
+
+def process_documents_device(
+    config: PipelineConfig,
+    docs: Iterable[Union[TextDocument, PipelineError]],
+    device_batch: Optional[int] = None,
+    on_read_error=None,
+    buckets=DEFAULT_BUCKETS,
+    mesh=None,
+) -> Iterator[ProcessingOutcome]:
+    """Device-backed processing loop: packs the stream into bucketed batches,
+    runs the compiled pipeline, assembles outcomes in input order per batch."""
+    pipeline = CompiledPipeline(
+        config, buckets=buckets, batch_size=device_batch or 256, mesh=mesh
+    )
+
+    if pipeline.fully_host or not pipeline.device_steps:
+        if pipeline.device_steps and pipeline.fully_host:
+            logger.warning(
+                "Pipeline has un-kerneled steps before device steps; "
+                "running fully on host."
+            )
+        from ..orchestration import process_documents_host
+
+        yield from process_documents_host(
+            pipeline.host_executor, docs, on_read_error=on_read_error
+        )
+        return
+
+    def doc_stream():
+        for item in docs:
+            if isinstance(item, PipelineError):
+                logger.warning("Error reading document for task. Skipping. %s", item)
+                if on_read_error is not None:
+                    on_read_error(item)
+                continue
+            yield item
+
+    for batch, fallback in iter_packed_batches(
+        doc_stream(), batch_size=pipeline.batch_size, buckets=buckets
+    ):
+        if batch is not None:
+            yield from pipeline.process_batch(batch)
+        for doc in fallback:
+            outcome = execute_processing_pipeline(pipeline.host_executor, doc)
+            if outcome is not None:
+                yield outcome

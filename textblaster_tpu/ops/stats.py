@@ -1,0 +1,805 @@
+"""Per-filter statistic kernels over packed codepoint batches.
+
+Each kernel maps ``(cps [B, L], lengths [B])`` to per-document **integer**
+statistics.  Ratios, thresholds, and reason strings are computed host-side in
+float64 from these integers — identical to the oracle's arithmetic — so
+device/host parity cannot be broken by accumulation order (SURVEY.md §7
+stage 2: "segmented reductions ... then scalar threshold logic" — the scalar
+logic stays on the host).
+
+Structure recovery is scan-based: word/line/paragraph segmentation via
+segmented associative scans (:mod:`.device`), citation matching and sentence
+boundaries via DFA composition (:mod:`.dfa`), duplicate detection via in-row
+sorts of (hash, length) keys.  All per-segment scatters write exactly once
+per slot (at segment-end positions) — duplicate-index scatter order is
+undefined in XLA.
+
+Known device/oracle divergences (each measured by the parity suite,
+tests/test_device_parity.py):
+* duplicate detection compares 32-bit content hashes, not strings —
+  cross-content collisions are ~2^-32 per pair;
+* ``find_all_duplicate``'s greedy skip treats "earlier occurrence" as any
+  earlier window, where the oracle only consults *visited* windows
+  (text.rs:241-259); these differ only when a window's sole earlier twin was
+  itself inside a skipped span.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.text import _CLOSE, _PARA_SEP, _SP, _STERM
+from .compact import compact
+from .device import (
+    ALNUM,
+    ALPHA,
+    DIGIT,
+    LOWER,
+    PUNCT,
+    WS,
+    classify,
+    isin_sorted,
+    lower_table,
+    rev,
+    seg_scan_add,
+    seg_scan_max,
+    seg_scan_or,
+    utf8_width,
+    word_mask,
+)
+from .dfa import citation_spans, dfa_states
+
+__all__ = [
+    "TextStructure",
+    "structure",
+    "gopher_quality_stats",
+    "fineweb_stats",
+    "gopher_rep_stats",
+    "c4_stage",
+    "C4Params",
+    "sentence_counts",
+    "hash_string",
+]
+
+NL = ord("\n")
+CR = ord("\r")
+
+
+def _shift_r(x: jax.Array, fill=0) -> jax.Array:
+    """x[i-1] along axis 1 (``fill`` at position 0)."""
+    return jnp.concatenate([jnp.full_like(x[:, :1], fill), x[:, :-1]], axis=1)
+
+
+def _shift_l(x: jax.Array, fill=0) -> jax.Array:
+    """x[i+1] along axis 1 (``fill`` at last position)."""
+    return jnp.concatenate([x[:, 1:], jnp.full_like(x[:, :1], fill)], axis=1)
+
+
+def _first_col(mask: jax.Array) -> jax.Array:
+    out = jnp.zeros_like(mask, dtype=bool)
+    return out.at[:, 0].set(True)
+
+
+def hash_string(s: str) -> int:
+    """Host twin of the device polynomial hash (int32 wraparound, mul 31)."""
+    h = 0
+    for ch in s:
+        h = (h * 31 + ord(ch)) & 0xFFFFFFFF
+    return h - (1 << 32) if h >= (1 << 31) else h
+
+
+def _poly_hash(cps: jax.Array, in_seg: jax.Array, seg_start: jax.Array) -> jax.Array:
+    """Segmented polynomial hash h = h*31 + cp via affine associative scan.
+
+    Positions outside segments are pass-through; ``seg_start`` restarts.
+    The value at each position is the hash of its segment's prefix.
+    """
+    m = jnp.where(seg_start, 0, jnp.where(in_seg, 31, 1)).astype(jnp.int32)
+    a = jnp.where(in_seg, cps, 0).astype(jnp.int32)
+
+    def compose(x, y):
+        mx, ax = x
+        my, ay = y
+        return mx * my, ay + my * ax
+
+    _, h = jax.lax.associative_scan(compose, (m, a), axis=1)
+    return h
+
+
+def _scatter(values, idx, active, m, fill=0, op="set"):
+    """Scatter per-char ``values`` at ``active`` positions into ``[B, m]``
+    slots keyed by ``idx``.  With op="set", callers must guarantee one active
+    position per slot."""
+    b = values.shape[0]
+    rows = jnp.arange(b, dtype=jnp.int32)[:, None]
+    ok = active & (idx >= 0) & (idx < m)
+    flat_idx = jnp.where(ok, rows * m + idx, b * m)
+    out = jnp.full(b * m + 1, fill, dtype=values.dtype)
+    src = jnp.where(ok, values, fill).reshape(-1)
+    ref = out.at[flat_idx.reshape(-1)]
+    if op == "set":
+        out = ref.set(values.reshape(-1), mode="drop")
+    elif op == "add":
+        out = ref.add(src, mode="drop")
+    elif op == "max":
+        out = ref.max(src, mode="drop")
+    else:
+        raise ValueError(op)
+    return out[:-1].reshape(b, m)
+
+
+class TextStructure(NamedTuple):
+    """Shared word-unit structure extracted once per packed batch."""
+
+    cps: jax.Array  # [B, L] int32
+    lengths: jax.Array  # [B]
+    cls: jax.Array  # [B, L] uint8 class bits
+    mask: jax.Array  # [B, L] bool — char belongs to the doc
+    unit_end: jax.Array  # [B, L] bool — last char of each unit
+    unit_valid: jax.Array  # [B, L] bool at unit_end — unit counts as a word
+    unit_len: jax.Array  # [B, L] int32 at unit_end — chars in unit
+    unit_bytes: jax.Array  # [B, L] int32 at unit_end — UTF-8 bytes of unit
+    unit_hash: jax.Array  # [B, L] int32 at unit_end — content hash
+    unit_lhash: jax.Array  # [B, L] int32 at unit_end — lowercased hash
+    unit_alpha: jax.Array  # [B, L] bool at unit_end — has alphabetic char
+    n_words: jax.Array  # [B] int32 — valid unit count
+    word_idx: jax.Array  # [B, L] int32 at valid unit_end — word ordinal
+
+
+def structure(cps: jax.Array, lengths: jax.Array) -> TextStructure:
+    _, length = cps.shape
+    mask = jnp.arange(length, dtype=jnp.int32)[None, :] < lengths[:, None]
+    cls = classify(cps)
+    cls = jnp.where(mask, cls, 0).astype(cls.dtype)
+
+    in_word = word_mask(cps, cls) & mask
+    ws = (cls & WS) != 0
+    punct = (cls & PUNCT) != 0
+    symbol = ~in_word & ~ws & ~punct & mask
+
+    in_unit = in_word | symbol
+    prev_in_word = _shift_r(in_word, False)
+    unit_start = (in_word & ~prev_in_word) | symbol
+    next_start = _shift_l(unit_start, False)
+    next_in_unit = _shift_l(in_unit, False)
+    unit_end = in_unit & (~next_in_unit | next_start)
+
+    ones = jnp.where(in_unit, 1, 0).astype(jnp.int32)
+    unit_len = seg_scan_add(ones, unit_start)
+    widths = jnp.where(in_unit, utf8_width(cps), 0)
+    unit_bytes = seg_scan_add(widths, unit_start)
+    nonpunct = jnp.where(in_unit, (~punct).astype(jnp.int32), 0)
+    unit_valid = seg_scan_or(nonpunct, unit_start) > 0
+    alpha = jnp.where(in_unit, ((cls & ALPHA) != 0).astype(jnp.int32), 0)
+    unit_alpha = seg_scan_or(alpha, unit_start) > 0
+
+    unit_hash = _poly_hash(cps, in_unit, unit_start)
+    lt = lower_table()
+    low = lt[jnp.minimum(cps, lt.shape[0] - 1)]
+    unit_lhash = _poly_hash(low, in_unit, unit_start)
+
+    valid_end = unit_end & unit_valid
+    word_idx = jnp.cumsum(valid_end.astype(jnp.int32), axis=1) - 1
+    n_words = jnp.sum(valid_end, axis=1).astype(jnp.int32)
+
+    return TextStructure(
+        cps=cps,
+        lengths=lengths,
+        cls=cls,
+        mask=mask,
+        unit_end=unit_end,
+        unit_valid=unit_valid,
+        unit_len=unit_len,
+        unit_bytes=unit_bytes,
+        unit_hash=unit_hash,
+        unit_lhash=unit_lhash,
+        unit_alpha=unit_alpha,
+        n_words=n_words,
+        word_idx=word_idx,
+    )
+
+
+def _lowered(cps: jax.Array, mask: jax.Array) -> jax.Array:
+    lt = lower_table()
+    return jnp.where(mask, lt[jnp.minimum(cps, lt.shape[0] - 1)], 0)
+
+
+def _match_pattern(src: jax.Array, mask: jax.Array, pattern: str) -> jax.Array:
+    """[B, L] bool: fixed string ``pattern`` starts at each position."""
+    hit = mask
+    for k, ch in enumerate(pattern):
+        shifted = jnp.pad(src[:, k:], ((0, 0), (0, k)), constant_values=-1)
+        mk = jnp.pad(mask[:, k:], ((0, 0), (0, k)), constant_values=False)
+        hit = hit & (shifted == ord(ch)) & mk
+    return hit
+
+
+# --- Line structure ----------------------------------------------------------
+
+
+class LineInfo(NamedTuple):
+    line_id: jax.Array  # [B, L] int32 — rust_lines index per char
+    line_start: jax.Array  # [B, L] bool — first char of each line (or its \n)
+    content: jax.Array  # [B, L] bool — not \n, not \r-before-\n
+    is_nl: jax.Array  # [B, L] bool
+    n_lines: jax.Array  # [B] int32 — rust_lines count
+    last_content: jax.Array  # [B, L] bool — last content char of its line
+
+
+def line_info(cps: jax.Array, mask: jax.Array) -> LineInfo:
+    is_nl = (cps == NL) & mask
+    next_is_nl = _shift_l(is_nl, False)
+    stripped_cr = (cps == CR) & next_is_nl & mask
+    content = mask & ~is_nl & ~stripped_cr
+
+    line_id = jnp.cumsum(is_nl.astype(jnp.int32), axis=1) - is_nl.astype(jnp.int32)
+
+    prev_nl = _shift_r(is_nl, False)
+    line_start = mask & (prev_nl | _first_col(mask))
+
+    # last content char of its line: next non-content or row end.
+    last_content = content & ~_shift_l(content, False)
+
+    n_newlines = jnp.sum(is_nl, axis=1).astype(jnp.int32)
+    lengths = jnp.sum(mask, axis=1).astype(jnp.int32)
+    pos = jnp.arange(cps.shape[1], dtype=jnp.int32)[None, :]
+    last_char_nl = jnp.any((pos == lengths[:, None] - 1) & is_nl, axis=1)
+    n_lines = jnp.where(
+        lengths == 0, 0, n_newlines + jnp.where(last_char_nl, 0, 1)
+    ).astype(jnp.int32)
+    return LineInfo(line_id, line_start, content, is_nl, n_lines, last_content)
+
+
+def _line_reset(li: LineInfo, mask: jax.Array) -> jax.Array:
+    """Scan-reset mask starting a fresh segment at each line's first char
+    (resets placed on the char after each \\n, and at column 0)."""
+    return _first_col(mask) | _shift_r(li.is_nl, False)
+
+
+def _first_nonws_in_line(nonws: jax.Array, li: LineInfo, mask: jax.Array) -> jax.Array:
+    cnt = seg_scan_add(nonws.astype(jnp.int32), _line_reset(li, mask))
+    return nonws & (cnt == 1)
+
+
+def _last_nonws_in_line(nonws: jax.Array, li: LineInfo, mask: jax.Array) -> jax.Array:
+    r_reset = _first_col(mask) | _shift_r(rev(li.is_nl), False)
+    cnt_r = seg_scan_add(rev(nonws).astype(jnp.int32), r_reset)
+    return rev(rev(nonws) & (cnt_r == 1))
+
+
+# --- Duplicate counting over (hash, bytes) tables ----------------------------
+# Sorting uses lax.sort's lexicographic multi-operand mode so every key stays
+# int32 (JAX x64 mode is off, and int32 sorts are faster on TPU anyway).
+# Invalid slots carry a leading 1 key, sorting them past all real segments.
+
+
+def _sort_triple(seg_hash, second, seg_valid):
+    invalid = (~seg_valid).astype(jnp.int32)
+    s_invalid, s_hash, s_second = jax.lax.sort(
+        (invalid, seg_hash.astype(jnp.int32), second.astype(jnp.int32)),
+        dimension=1,
+        num_keys=3,
+    )
+    return s_invalid == 0, s_hash, s_second
+
+
+def _dup_counts(seg_hash, seg_bytes, seg_valid) -> Tuple[jax.Array, jax.Array]:
+    """find_duplicates semantics over hashed segments: every occurrence after
+    the first counts (text.rs:197-208)."""
+    is_real, s_hash, s_bytes = _sort_triple(seg_hash, seg_bytes, seg_valid)
+    same_prev = (
+        jnp.concatenate(
+            [
+                jnp.zeros_like(is_real[:, :1]),
+                (s_hash[:, 1:] == s_hash[:, :-1]) & (s_bytes[:, 1:] == s_bytes[:, :-1]),
+            ],
+            axis=1,
+        )
+        & is_real
+    )
+    dup_elems = jnp.sum(same_prev, axis=1).astype(jnp.int32)
+    dup_bytes = jnp.sum(jnp.where(same_prev, s_bytes, 0), axis=1).astype(jnp.int32)
+    return dup_elems, dup_bytes
+
+
+def _top_duplicate(seg_hash, seg_bytes, seg_valid) -> jax.Array:
+    """find_top_duplicate semantics: bytes*count of the most frequent item,
+    ties by larger contribution, 0 when nothing repeats (text.rs:211-238)."""
+    is_real, s_hash, s_bytes = _sort_triple(seg_hash, seg_bytes, seg_valid)
+    run_start = jnp.concatenate(
+        [
+            jnp.ones_like(is_real[:, :1]),
+            (s_hash[:, 1:] != s_hash[:, :-1]) | (s_bytes[:, 1:] != s_bytes[:, :-1]),
+        ],
+        axis=1,
+    )
+    run_len = seg_scan_add(jnp.ones_like(s_hash), run_start)
+    run_end = _shift_l(run_start, True)
+    counts = jnp.where(run_end & is_real, run_len, 0)
+    max_count = jnp.max(counts, axis=1, keepdims=True)
+    contrib = jnp.where(
+        run_end & is_real & (run_len == max_count), s_bytes * run_len, 0
+    )
+    top = jnp.max(contrib, axis=1)
+    return jnp.where(max_count[:, 0] > 1, top, 0).astype(jnp.int32)
+
+
+# --- GopherQuality -----------------------------------------------------------
+
+
+def gopher_quality_stats(
+    st: TextStructure, stop_word_hashes: Sequence[int]
+) -> Dict[str, jax.Array]:
+    """Integer stats for GopherQualityFilter (gopher_quality.rs:69-295)."""
+    cps, cls, mask = st.cps, st.cls, st.mask
+    valid_end = st.unit_end & st.unit_valid
+
+    n_words = st.n_words
+    sum_len = jnp.sum(jnp.where(valid_end, st.unit_len, 0), axis=1).astype(jnp.int32)
+
+    hash_count = jnp.sum((cps == ord("#")) & mask, axis=1).astype(jnp.int32)
+
+    # Non-overlapping "..." count: dot-run lengths // 3 (str::matches parity).
+    is_dot = (cps == ord(".")) & mask
+    dot_start = is_dot & ~_shift_r(is_dot, False)
+    dot_run = seg_scan_add(is_dot.astype(jnp.int32), dot_start)
+    dot_end = is_dot & ~_shift_l(is_dot, False)
+    ellipsis_ascii = jnp.sum(jnp.where(dot_end, dot_run // 3, 0), axis=1)
+    ellipsis_uni = jnp.sum((cps == 0x2026) & mask, axis=1)
+    ellipsis_units = (ellipsis_ascii + ellipsis_uni).astype(jnp.int32)
+
+    li = line_info(cps, mask)
+    ws = (cls & WS) != 0
+    nonws = li.content & ~ws
+
+    # Bullet lines: first non-ws char is '•' or '-' (trim_start semantics).
+    leader = _first_nonws_in_line(nonws, li, mask)
+    is_bullet_char = (cps == 0x2022) | (cps == ord("-"))
+    bullet_lines = jnp.sum(leader & is_bullet_char, axis=1).astype(jnp.int32)
+
+    # Ellipsis-ended lines: last non-ws char is '…' or closes a >=3 dot run.
+    last_nonws = _last_nonws_in_line(nonws, li, mask)
+    ell_line = last_nonws & ((cps == 0x2026) | (is_dot & (dot_run >= 3)))
+    ellipsis_lines = jnp.sum(ell_line, axis=1).astype(jnp.int32)
+
+    alpha_words = jnp.sum(valid_end & st.unit_alpha, axis=1).astype(jnp.int32)
+
+    if stop_word_hashes:
+        sw = jnp.asarray(np.sort(np.array(stop_word_hashes, dtype=np.int32)))
+        is_stop = isin_sorted(st.unit_lhash, sw)
+        stop_words = jnp.sum(valid_end & is_stop, axis=1).astype(jnp.int32)
+    else:
+        stop_words = jnp.zeros_like(n_words)
+
+    return {
+        "n_words": n_words,
+        # All valid units contain a non-PUNCT char, so non_symbol == words.
+        "n_non_symbol": n_words,
+        "sum_word_len": sum_len,
+        "hash_count": hash_count,
+        "ellipsis_units": ellipsis_units,
+        "n_lines": li.n_lines,
+        "bullet_lines": bullet_lines,
+        "ellipsis_lines": ellipsis_lines,
+        "alpha_words": alpha_words,
+        "stop_words": stop_words,
+    }
+
+
+# --- FineWeb -----------------------------------------------------------------
+
+
+def fineweb_stats(
+    st: TextStructure, stop_chars: Sequence[str], max_lines: int
+) -> Dict[str, jax.Array]:
+    """Integer stats for FineWebQualityFilter (fineweb_quality.rs:71-225)."""
+    cps, cls, mask = st.cps, st.cls, st.mask
+    li = line_info(cps, mask)
+    ws = (cls & WS) != 0
+    nonws = li.content & ~ws
+    reset = _line_reset(li, mask)
+
+    # Per-line cumulative values, scattered once at the line's last content
+    # char (single write per slot).
+    char_cnt = seg_scan_add(li.content.astype(jnp.int32), reset)
+    byte_cnt = seg_scan_add(jnp.where(li.content, utf8_width(cps), 0), reset)
+    has_nonws = seg_scan_or(nonws.astype(jnp.int32), reset)
+    line_hash = _poly_hash(cps, li.content, reset)
+
+    lc = li.last_content
+    line_chars = _scatter(char_cnt, li.line_id, lc, max_lines)
+    line_bytes = _scatter(byte_cnt, li.line_id, lc, max_lines)
+    line_has_content = _scatter(has_nonws, li.line_id, lc, max_lines) > 0
+    line_hash_t = _scatter(line_hash, li.line_id, lc, max_lines)
+
+    n_nonblank = jnp.sum(line_has_content, axis=1).astype(jnp.int32)
+
+    last_nonws = _last_nonws_in_line(nonws, li, mask)
+    sc = jnp.asarray(np.sort(np.array([ord(c) for c in stop_chars], dtype=np.int32)))
+    ends_stop_char = last_nonws & isin_sorted(cps, sc)
+    ends_stop = jnp.sum(ends_stop_char, axis=1).astype(jnp.int32)
+
+    dup_elems, dup_bytes = _dup_counts(line_hash_t, line_bytes, line_has_content)
+
+    total_chars_no_nl = jnp.sum(mask & ~li.is_nl, axis=1).astype(jnp.int32)
+    newline_count = jnp.sum(li.is_nl, axis=1).astype(jnp.int32)
+
+    return {
+        "n_nonblank_lines": n_nonblank,
+        "lines_ending_stop": ends_stop,
+        "line_chars": line_chars,  # [B, ML]
+        "line_has_content": line_has_content,  # [B, ML]
+        "dup_line_bytes": dup_bytes,
+        "total_chars_no_newline": total_chars_no_nl,
+        "n_words": st.n_words,
+        "newline_count": newline_count,
+        "line_overflow": li.n_lines > max_lines,
+    }
+
+
+# --- GopherRepetition --------------------------------------------------------
+
+
+def gopher_rep_stats(
+    st: TextStructure,
+    top_ns: Sequence[int],
+    dup_ns: Sequence[int],
+    max_segs: int,
+    max_words: int,
+) -> Dict[str, jax.Array]:
+    """Integer stats for GopherRepetitionFilter (gopher_rep.rs:52-219)."""
+    cps, cls, mask = st.cps, st.cls, st.mask
+    ws = (cls & WS) != 0
+    _, length = cps.shape
+    pos = jnp.arange(length, dtype=jnp.int32)[None, :]
+
+    # Trim bounds (gopher_rep.rs:57).
+    nonws = mask & ~ws
+    any_nonws = jnp.any(nonws, axis=1)
+    t0 = jnp.min(jnp.where(nonws, pos, length), axis=1)
+    t1 = jnp.max(jnp.where(nonws, pos, -1), axis=1)
+    in_trim = (pos >= t0[:, None]) & (pos <= t1[:, None]) & mask
+    trimmed_len = jnp.where(any_nonws, t1 - t0 + 1, 0).astype(jnp.int32)
+
+    is_nl = (cps == NL) & in_trim
+    prev_nl = _shift_r(is_nl, False)
+    at_t0 = pos == t0[:, None]
+
+    # Line segments: split on \n+.
+    l_content = in_trim & ~is_nl
+    l_start = l_content & (prev_nl | at_t0)
+
+    # Paragraph separators: \n chars inside runs of >= 2.
+    nl_start = is_nl & ~prev_nl
+    nl_run = seg_scan_add(is_nl.astype(jnp.int32), nl_start)
+    nl_run_end = is_nl & ~_shift_l(is_nl, False)
+    run_total = rev(
+        seg_scan_max(rev(jnp.where(nl_run_end, nl_run, 0)), rev(nl_run_end))
+    )
+    is_sep = is_nl & (run_total >= 2)
+    p_content = in_trim & ~is_sep
+    p_start = p_content & (_shift_r(is_sep, False) | at_t0)
+
+    def seg_table(content, start):
+        seg_id = jnp.cumsum(start.astype(jnp.int32), axis=1) - 1
+        end = content & ~_shift_l(content, False)
+        h = _poly_hash(cps, content, start)
+        by = seg_scan_add(jnp.where(content, utf8_width(cps), 0), start)
+        tbl_h = _scatter(h, seg_id, end, max_segs)
+        tbl_b = _scatter(by, seg_id, end, max_segs)
+        n = jnp.sum(start, axis=1).astype(jnp.int32)
+        tbl_valid = jnp.arange(max_segs, dtype=jnp.int32)[None, :] < n[:, None]
+        return tbl_h, tbl_b, tbl_valid, n
+
+    lh, lb, lv, n_l = seg_table(l_content, l_start)
+    ph, pb, pv, n_p = seg_table(p_content, p_start)
+    l_dup_elems, l_dup_bytes = _dup_counts(lh, lb, lv)
+    p_dup_elems, p_dup_bytes = _dup_counts(ph, pb, pv)
+
+    # Word tables for n-grams.
+    valid_end = st.unit_end & st.unit_valid
+    whash = _scatter(st.unit_hash, st.word_idx, valid_end, max_words)
+    wbytes = _scatter(st.unit_bytes, st.word_idx, valid_end, max_words)
+    n_words = st.n_words
+    widx = jnp.arange(max_words, dtype=jnp.int32)[None, :]
+
+    out: Dict[str, jax.Array] = {
+        "trimmed_len": trimmed_len,
+        "n_paragraphs": n_p,
+        "para_dup_elems": p_dup_elems,
+        "para_dup_bytes": p_dup_bytes,
+        "n_lines": n_l,
+        "line_dup_elems": l_dup_elems,
+        "line_dup_bytes": l_dup_bytes,
+        "seg_overflow": (n_l > max_segs) | (n_p > max_segs),
+        "word_overflow": n_words > max_words,
+    }
+
+    for n in sorted(set(list(top_ns) + list(dup_ns))):
+        gh = jnp.zeros_like(whash)
+        gb = jnp.zeros_like(wbytes)
+        for k in range(n):
+            gh = gh * jnp.int32(1000003) + jnp.pad(whash[:, k:], ((0, 0), (0, k)))
+            gb = gb + jnp.pad(wbytes[:, k:], ((0, 0), (0, k)))
+        win_valid = (widx + n) <= n_words[:, None]
+        if n in top_ns:
+            # " "-joined n-grams: byte length includes n-1 single-byte spaces.
+            out[f"top_{n}"] = _top_duplicate(gh, gb + (n - 1), win_valid)
+        if n in dup_ns:
+            out[f"dup_{n}"] = _greedy_dup_bytes(gh, gb, win_valid, n)
+    return out
+
+
+def _greedy_dup_bytes(gh, gb, win_valid, n: int) -> jax.Array:
+    """find_all_duplicate: non-overlapping greedy scan, advancing n on a hit
+    (text.rs:241-259); see module docstring for the visited-set approximation."""
+    b, m = gh.shape
+    idx = jnp.broadcast_to(jnp.arange(m, dtype=jnp.int32)[None, :], (b, m))
+    is_real, s_hash, sidx = _sort_triple(gh, idx, win_valid)
+    run_start = jnp.concatenate(
+        [
+            jnp.ones((b, 1), dtype=bool),
+            s_hash[:, 1:] != s_hash[:, :-1],
+        ],
+        axis=1,
+    )
+    # Sorted by (hash, idx): the run's first slot holds the minimum index.
+    first_in_run = seg_scan_max(jnp.where(run_start, sidx, -(2**30)), run_start)
+    first_occ = _scatter(first_in_run, sidx, is_real, m)
+
+    def step(carry, i):
+        next_allowed, acc = carry
+        active = (i >= next_allowed) & win_valid[:, i]
+        isdup = active & (first_occ[:, i] < i)
+        acc = acc + jnp.where(isdup, gb[:, i], 0)
+        next_allowed = jnp.where(isdup, i + n, next_allowed)
+        return (next_allowed, acc), None
+
+    init = (jnp.zeros(b, dtype=jnp.int32), jnp.zeros(b, dtype=jnp.int32))
+    (_, acc), _ = jax.lax.scan(step, init, jnp.arange(m, dtype=jnp.int32))
+    return acc
+
+
+# --- Sentence counting (device twin of split_into_sentences) -----------------
+
+_TERM_SET = np.sort(np.array([ord(c) for c in ("." + _STERM)], dtype=np.int32))
+_STERM_SET = np.sort(np.array([ord(c) for c in _STERM], dtype=np.int32))
+_CLOSE_SET = np.sort(np.array([ord(c) for c in _CLOSE], dtype=np.int32))
+_SP_SET = np.sort(np.array([ord(c) for c in _SP], dtype=np.int32))
+_PSEP_SET = np.sort(np.array([ord(c) for c in _PARA_SEP], dtype=np.int32))
+
+# Match DFA over symbols 0=other, 1=TERM, 2=CLOSE, 3=SP.
+# States: 0 outside, 1 in terms, 2 in closes, 3 in spaces.
+_SENT_T = np.zeros((4, 4), dtype=np.int32)
+_SENT_T[0, :] = 0
+_SENT_T[1, :] = 1
+_SENT_T[2, :] = [0, 2, 2, 0]
+_SENT_T[3, :] = [0, 3, 3, 3]
+
+
+def sentence_counts(cps: jax.Array, lengths: jax.Array) -> jax.Array:
+    """Sentences per row — ``len(split_into_sentences(text))`` for rows whose
+    content is already globally trimmed (C4's rewritten batches are)."""
+    _, length = cps.shape
+    mask = jnp.arange(length, dtype=jnp.int32)[None, :] < lengths[:, None]
+    cls = classify(cps)
+    cls = jnp.where(mask, cls, 0).astype(cls.dtype)
+
+    term = isin_sorted(cps, jnp.asarray(_TERM_SET)) & mask
+    sterm = isin_sorted(cps, jnp.asarray(_STERM_SET)) & mask
+    close = isin_sorted(cps, jnp.asarray(_CLOSE_SET)) & mask
+    sp = isin_sorted(cps, jnp.asarray(_SP_SET)) & mask
+    psep = isin_sorted(cps, jnp.asarray(_PSEP_SET)) & mask
+
+    sym = jnp.zeros_like(cps)
+    sym = jnp.where(term, 1, sym)
+    sym = jnp.where(close & ~term, 2, sym)
+    sym = jnp.where(sp & ~close & ~term, 3, sym)
+    state = dfa_states(sym, _SENT_T)
+    prev_state = _shift_r(state, 0)
+
+    # Match-start: a terminator not already inside a terminator run.
+    match_start = term & (prev_state != 1)
+    has_sterm = (
+        seg_scan_or(jnp.where(state > 0, sterm.astype(jnp.int32), 0), match_start) > 0
+    )
+    prev_has_sterm = _shift_r(has_sterm.astype(jnp.int32), 0) > 0
+    dot_last = (
+        _shift_r((cps == ord(".")) & mask, False) & (prev_state == 1)
+    )
+
+    lower = (cls & LOWER) != 0
+    alnum_ = ((cls & ALNUM) != 0) | (cps == ord("_"))
+
+    # Boundary candidate: previous char inside a match; current char either
+    # exits the match or starts a fresh terminator run after closes/spaces.
+    fresh_term = term & ((prev_state == 2) | (prev_state == 3))
+    candidate = mask & (prev_state > 0) & ((state == 0) | fresh_term)
+
+    no_break = ~prev_has_sterm & ((dot_last & alnum_) | lower)
+    boundary = (candidate & ~no_break) | (_shift_r(psep, False) & mask)
+
+    # Count segments containing >= 1 non-ws char.
+    ws = (cls & WS) != 0
+    nonws = mask & ~ws
+    seg_begin = boundary | _first_col(mask)
+    cnt = seg_scan_add(nonws.astype(jnp.int32), seg_begin)
+    first_nonws = nonws & (cnt == 1)
+    return jnp.sum(first_nonws, axis=1).astype(jnp.int32)
+
+
+# --- C4 stage ----------------------------------------------------------------
+
+
+class C4Params(NamedTuple):
+    split_paragraph: bool
+    remove_citations: bool
+    filter_no_terminal_punct: bool
+    min_num_sentences: int
+    min_words_per_line: int
+    max_word_length: int
+    filter_lorem_ipsum: bool
+    filter_javascript: bool
+    filter_curly_bracket: bool
+    filter_policy: bool
+
+
+_END_PUNCT_SET = np.sort(
+    np.array([ord(c) for c in (".", "!", "?", '"', "'", "”")], dtype=np.int32)
+)
+
+_POLICY = (
+    "terms of use",
+    "privacy policy",
+    "cookie policy",
+    "uses cookies",
+    "use of cookies",
+    "use cookies",
+)
+
+
+def c4_stage(
+    cps: jax.Array,
+    lengths: jax.Array,
+    params: C4Params,
+    max_lines: int,
+) -> Tuple[Dict[str, jax.Array], jax.Array, jax.Array]:
+    """The C4 quality filter as a device stage (c4_filters.rs:147-295).
+
+    Returns ``(stats, new_cps, new_lengths)``: the new batch is the rewritten
+    content (kept lines joined by ``\\n``) for every row.
+
+    Only ``split_paragraph=True`` (the shipped config's mode) runs on device;
+    sentence-split mode goes through the host fallback.
+    """
+    _, length = cps.shape
+    mask = jnp.arange(length, dtype=jnp.int32)[None, :] < lengths[:, None]
+    cls = classify(cps)
+    cls = jnp.where(mask, cls, 0).astype(cls.dtype)
+    ws = (cls & WS) != 0
+    low = _lowered(cps, mask)
+
+    # Doc-level early rejects (c4_filters.rs:166-187).
+    has_lorem = jnp.any(_match_pattern(low, mask, "lorem ipsum"), axis=1)
+    has_curly = jnp.any(((cps == ord("{")) | (cps == ord("}"))) & mask, axis=1)
+
+    li = line_info(cps, mask)
+    nonws = li.content & ~ws
+    reset = _line_reset(li, mask)
+
+    # Per-line trim: chars at/after the first non-ws and at/before the last.
+    after_first = seg_scan_add(nonws.astype(jnp.int32), reset) >= 1
+    r_reset = _first_col(mask) | _shift_r(rev(li.is_nl), False)
+    before_last = rev(seg_scan_add(rev(nonws).astype(jnp.int32), r_reset) >= 1)
+    in_line_trim = li.content & after_first & before_last
+
+    if params.remove_citations:
+        deleted = citation_spans(
+            jnp.where(li.content, cps, 0),
+            ((cls & DIGIT) != 0) & li.content,
+            ws & li.content,
+        )
+    else:
+        deleted = jnp.zeros_like(mask)
+
+    keep1 = (in_line_trim & ~deleted) | li.is_nl
+    c1_cps, c1_len = compact(cps, keep1)
+
+    # --- per-line checks on the compacted batch ---
+    m1 = jnp.arange(length, dtype=jnp.int32)[None, :] < c1_len[:, None]
+    st1 = structure(c1_cps, c1_len)
+    li1 = line_info(c1_cps, m1)
+    low1 = _lowered(c1_cps, m1)
+
+    valid_end1 = st1.unit_end & st1.unit_valid
+    line_words = _scatter(
+        jnp.ones_like(c1_cps), li1.line_id, valid_end1, max_lines, op="add"
+    )
+    line_max_word = _scatter(
+        st1.unit_len, li1.line_id, valid_end1, max_lines, op="max"
+    )
+
+    # Terminal punctuation: last char of each (already trimmed) line.
+    line_last_char = _scatter(c1_cps, li1.line_id, li1.last_content, max_lines)
+    ends_terminal = isin_sorted(line_last_char, jnp.asarray(_END_PUNCT_SET)) & (
+        line_last_char > 0
+    )
+    is_dot1 = (c1_cps == ord(".")) & m1
+    dot_start1 = is_dot1 & ~_shift_r(is_dot1, False)
+    dot_run1 = seg_scan_add(is_dot1.astype(jnp.int32), dot_start1)
+    line_end_dots = _scatter(
+        jnp.where(is_dot1, dot_run1, 0), li1.line_id, li1.last_content, max_lines
+    )
+    ends_ellipsis = line_end_dots >= 3
+
+    def line_has_pattern(pat: str) -> jax.Array:
+        hit = _match_pattern(low1, m1, pat)
+        return (
+            _scatter(hit.astype(jnp.int32), li1.line_id, hit, max_lines, op="add") > 0
+        )
+
+    zeros_ml = jnp.zeros_like(ends_terminal)
+    has_js = line_has_pattern("javascript") if params.filter_javascript else zeros_ml
+    has_policy = zeros_ml
+    if params.filter_policy:
+        for p in _POLICY:
+            has_policy = has_policy | line_has_pattern(p)
+
+    # Line count comes from the ORIGINAL batch: a final line whose content
+    # trimmed away entirely has no chars and no trailing \n in the compacted
+    # batch, so li1 under-counts it — but it still exists as a (droppable)
+    # line in the oracle's rust_lines view.
+    n_lines1 = li.n_lines
+    line_exists = jnp.arange(max_lines, dtype=jnp.int32)[None, :] < n_lines1[:, None]
+
+    if params.max_word_length > 0:
+        drop_too_long = line_exists & (line_max_word > params.max_word_length)
+    else:
+        drop_too_long = jnp.zeros_like(line_exists)
+    remaining = line_exists & ~drop_too_long
+    if params.filter_no_terminal_punct:
+        drop_no_term = remaining & ~(ends_terminal & ~ends_ellipsis)
+    else:
+        drop_no_term = jnp.zeros_like(remaining)
+    remaining = remaining & ~drop_no_term
+    if params.min_words_per_line > 0:
+        drop_few_words = remaining & (line_words < params.min_words_per_line)
+    else:
+        drop_few_words = jnp.zeros_like(remaining)
+    remaining = remaining & ~drop_few_words
+    drop_js = remaining & has_js
+    remaining = remaining & ~drop_js
+    drop_policy = remaining & has_policy
+    line_keep = remaining & ~drop_policy
+
+    # --- compact kept lines into the rewritten batch ---
+    later = rev(jnp.cumsum(rev(line_keep.astype(jnp.int32)), axis=1), axis=1)
+    keep_later = _shift_l(later, 0) > 0  # a kept line exists after slot l
+
+    lid1 = jnp.minimum(li1.line_id, max_lines - 1)
+    char_line_keep = jnp.take_along_axis(line_keep, lid1, axis=1)
+    char_keep_later = jnp.take_along_axis(keep_later, lid1, axis=1)
+    keep2 = (li1.content & char_line_keep & m1) | (
+        li1.is_nl & char_line_keep & char_keep_later
+    )
+    c2_cps, c2_len = compact(c1_cps, keep2)
+
+    n_sent = sentence_counts(c2_cps, c2_len)
+
+    false_b = jnp.zeros_like(has_lorem)
+    stats = {
+        "has_lorem": has_lorem if params.filter_lorem_ipsum else false_b,
+        "has_curly": has_curly if params.filter_curly_bracket else false_b,
+        "n_sentences": n_sent,
+        "line_keep": line_keep,  # [B, ML]
+        "n_lines": jnp.minimum(n_lines1, jnp.int32(max_lines)),
+        "drop_too_long": jnp.sum(drop_too_long, axis=1).astype(jnp.int32),
+        "drop_no_term": jnp.sum(drop_no_term, axis=1).astype(jnp.int32),
+        "drop_few_words": jnp.sum(drop_few_words, axis=1).astype(jnp.int32),
+        "line_overflow": n_lines1 > max_lines,
+    }
+    return stats, c2_cps, c2_len

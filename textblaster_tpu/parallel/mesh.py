@@ -1,0 +1,47 @@
+"""Device mesh + sharding for the compiled pipeline.
+
+The reference scales by adding competing consumers on a RabbitMQ queue
+(SURVEY.md §2.5); here the equivalent is SPMD data parallelism over a
+``jax.sharding.Mesh``: packed batches are sharded along the ``data`` axis, the
+compiled filter program runs identically on every chip over its shard, and
+the (small) integer stat outputs are gathered back to the host — the
+"all-gather keep/drop masks over ICI" of the BASELINE.json north star.  The
+per-document kernels have no cross-document dependencies, so XLA partitions
+them without inserting any collectives until the output gather; scaling is
+linear in chips modulo input-feed bandwidth.
+
+Multi-host: under ``jax.distributed`` the same code runs with a global mesh —
+each host feeds its local shard (``host_local_array_to_global_array``), and
+output gathers ride DCN.  Single-host multi-chip needs no extra code.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["data_mesh", "shard_batch", "batch_sharding"]
+
+DATA_AXIS = "data"
+
+
+def data_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """1-D mesh over all (or the given) devices along the ``data`` axis."""
+    devs = list(devices) if devices is not None else jax.devices()
+    return Mesh(np.array(devs), (DATA_AXIS,))
+
+
+def batch_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
+    """Shard axis 0 (documents) across the mesh; other axes replicated."""
+    spec = P(DATA_AXIS, *([None] * (ndim - 1)))
+    return NamedSharding(mesh, spec)
+
+
+def shard_batch(mesh: Mesh, cps: np.ndarray, lengths: np.ndarray):
+    """Place a packed batch on the mesh, sharded along the document axis."""
+    cps_s = jax.device_put(cps, batch_sharding(mesh, 2))
+    len_s = jax.device_put(lengths, batch_sharding(mesh, 1))
+    return cps_s, len_s

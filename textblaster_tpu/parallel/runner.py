@@ -88,13 +88,18 @@ def run_pipeline(
     )
 
     if backend == "tpu":
-        from ..ops.pipeline import process_documents_device
+        import jax
 
+        from ..ops.pipeline import process_documents_device
+        from .mesh import data_mesh
+
+        mesh = data_mesh() if len(jax.devices()) > 1 else None
         outcomes = process_documents_device(
             config,
             docs,
             device_batch=device_batch,
             on_read_error=on_read_error,
+            mesh=mesh,
         )
     else:
         executor = build_pipeline_from_config(config)
