@@ -1,0 +1,198 @@
+"""Native C++ core parity tests.
+
+The numpy implementations in ``textblaster_tpu/utils/text.py`` are the
+semantic source of truth (themselves parity-tested against the reference's
+``src/utils/text.rs`` behavior); the native library must agree bit-for-bit.
+Mirrors the reference's unit-tier strategy for text primitives
+(src/utils/text.rs:261-467) plus a tokenizer-oracle check in the style of its
+token_counter tests (token_counter.rs:45-149).
+"""
+
+import string
+
+import numpy as np
+import pytest
+
+from textblaster_tpu import native
+from textblaster_tpu.utils import text as T
+from textblaster_tpu.utils.chartables import classify, codepoints
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable"
+)
+
+
+TEXTS = [
+    "Hello, world! Det er en god dag.",
+    "ordene og flere ord og flere ord her",
+    "tal 3.5 og 1,234 mid:word a·b _x_ ！？",
+    "日本語のテキストです。中文文本。",
+    "a" * 50 + " " + "b" * 50,
+    "",
+    "   ",
+    "...",
+    "x",
+    "Køb nu – spar 50%! Se mere i dag.",
+    "word\nword\nword\n\npara\n\npara",
+]
+
+
+def _spans(text):
+    cps = codepoints(text).astype(np.int32)
+    cls = classify(cps.astype(np.uint32))
+    return cps, native.word_spans_native(cps, cls)
+
+
+class TestWordSpans:
+    @pytest.mark.parametrize("text", TEXTS)
+    def test_matches_python(self, text):
+        cps = codepoints(text).astype(np.int32)
+        cls = classify(cps.astype(np.uint32))
+        got = native.word_spans_native(cps, cls)
+        want = np.array(T.word_spans(text), dtype=np.int32).reshape(-1, 2)
+        assert got.shape == want.shape
+        assert (got == want).all()
+
+    def test_fuzz(self):
+        rng = np.random.default_rng(7)
+        chars = string.ascii_letters + string.digits + " .,!?'\"\n\t_-·:æøå日本１％"
+        for _ in range(300):
+            n = int(rng.integers(0, 60))
+            text = "".join(chars[int(rng.integers(0, len(chars)))] for _ in range(n))
+            cps = codepoints(text).astype(np.int32)
+            cls = classify(cps.astype(np.uint32))
+            got = native.word_spans_native(cps, cls)
+            want = np.array(T.word_spans(text), dtype=np.int32).reshape(-1, 2)
+            assert got.shape == want.shape and (got == want).all(), repr(text)
+
+
+class TestPackUtf8:
+    def test_roundtrip(self):
+        blobs = [t.encode("utf-8") for t in TEXTS]
+        data = np.frombuffer(b"".join(blobs), dtype=np.uint8)
+        offs = np.cumsum([0] + [len(b) for b in blobs]).astype(np.int64)
+        cps, lens = native.pack_utf8(data, offs, max_len=128, batch_size=16)
+        for i, t in enumerate(TEXTS):
+            ref = codepoints(t).astype(np.int32)
+            assert lens[i] == len(ref)
+            assert (cps[i, : len(ref)] == ref).all()
+            assert (cps[i, len(ref) :] == 0).all()
+        assert (native.utf8_lengths(data, offs) == [len(t) for t in TEXTS]).all()
+
+    def test_overflow_flagged(self):
+        text = "æblegrød " * 40  # 360 chars, > 2-byte chars included
+        blob = text.encode("utf-8")
+        data = np.frombuffer(blob, dtype=np.uint8)
+        offs = np.array([0, len(blob)], dtype=np.int64)
+        cps, lens = native.pack_utf8(data, offs, max_len=100, batch_size=1)
+        assert lens[0] == -len(text)
+        assert (cps[0] == 0).all()
+
+
+class TestDupScans:
+    def test_fuzz_vs_python(self):
+        rng = np.random.default_rng(11)
+        pool = ["og", "det", "er", "en", "dag", "hund", "kat", "hus", "æble", "ø"]
+        for _ in range(100):
+            nw = int(rng.integers(0, 40))
+            text = " ".join(pool[int(rng.integers(0, len(pool)))] for _ in range(nw))
+            cps, spans = _spans(text)
+            words = [text[s:e] for s, e in spans]
+            assert words == T.split_into_words(text)
+            for n in (1, 2, 3, 5):
+                assert native.dup_ngram_bytes(cps, spans, n) == T.find_all_duplicate(
+                    words, n
+                )
+                assert native.top_ngram_bytes(cps, spans, n) == T.find_top_duplicate(
+                    T.get_n_grams(words, n)
+                )
+            got = native.dup_items(cps, spans)
+            assert got == T.find_duplicates(words)
+
+    def test_nonoverlap_advance(self):
+        # find_all_duplicate advances by n on a hit (text.rs:241-259; the
+        # worked example the reference tests in gopher_rep.rs:385-392).
+        text = "a a a a a"
+        cps, spans = _spans(text)
+        assert native.dup_ngram_bytes(cps, spans, 2) == 4
+
+    def test_concat_equality_not_wordwise(self):
+        # ["ab","c"] and ["a","bc"] concatenate equal — must count as dup.
+        text = "ab c a bc"
+        cps, spans = _spans(text)
+        assert native.dup_ngram_bytes(cps, spans, 2) == T.find_all_duplicate(
+            ["ab", "c", "a", "bc"], 2
+        )
+
+
+class TestBpe:
+    @pytest.fixture(scope="class")
+    def oracle(self):
+        tokenizers = pytest.importorskip("tokenizers")
+        from tokenizers import Tokenizer, pre_tokenizers
+        from tokenizers.models import BPE
+
+        alphabet = pre_tokenizers.ByteLevel.alphabet()
+        vocab = {ch: i for i, ch in enumerate(sorted(alphabet))}
+        merges = []
+        for a, b in [
+            ("h", "e"), ("l", "l"), ("he", "ll"), ("o", "w"), ("hell", "o"),
+            ("Ġ", "w"), ("Ġw", "o"), ("Ġwo", "r"), ("Ġwor", "l"), ("Ġworl", "d"),
+            ("e", "r"), ("t", "h"), ("th", "e"), ("Ġ", "the"), ("a", "n"),
+            ("an", "d"), ("1", "2"), ("12", "3"), ("Ã", "¦"), ("Ã", "¸"),
+        ]:
+            m = a + b
+            if m not in vocab:
+                vocab[m] = len(vocab)
+            merges.append((a, b))
+        tok = Tokenizer(BPE(vocab, merges))
+        tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+        merges_txt = "#version: 0.2\n" + "\n".join(f"{a} {b}" for a, b in merges)
+        return tok, native.BpeCounter(merges_txt)
+
+    @pytest.mark.parametrize(
+        "text",
+        [
+            "hello world",
+            "hello  world",
+            "the and the",
+            "it's we've they'll don't",
+            "abc123 def",
+            "   leading",
+            "trailing   ",
+            "tabs\t\tand\nnewlines\n",
+            "æble søen gård",
+            "日本語 text",
+            "a",
+            "",
+            " ",
+            "  ",
+            "!!!",
+            "price: $1,234.56 (12% off)!",
+        ],
+    )
+    def test_counts_match_hf(self, oracle, text):
+        tok, bpe = oracle
+        assert bpe.count(text) == len(tok.encode(text).tokens)
+
+    def test_fuzz_vs_hf(self, oracle):
+        tok, bpe = oracle
+        rng = np.random.default_rng(3)
+        chars = string.ascii_letters + string.digits + " .,!?'\"\n\tæøå日本"
+        for _ in range(150):
+            n = int(rng.integers(0, 50))
+            text = "".join(chars[int(rng.integers(0, len(chars)))] for _ in range(n))
+            assert bpe.count(text) == len(tok.encode(text).tokens), repr(text)
+
+    def test_token_counter_uses_native_bpe(self, oracle, tmp_path):
+        _, _ = oracle
+        merges = tmp_path / "merges.txt"
+        merges.write_text("#version: 0.2\nh e\nl l\nhe ll\nhell o\n")
+        from textblaster_tpu.data_model import TextDocument
+        from textblaster_tpu.filters.token_counter import TokenCounter
+
+        tc = TokenCounter(str(merges))
+        doc = TextDocument(id="1", source="s", content="hello hello")
+        out = tc.process(doc)
+        # "hello" -> 1 token, " hello" -> "Ġ" + "hello" -> 2 tokens.
+        assert out.metadata["token_count"] == "3"

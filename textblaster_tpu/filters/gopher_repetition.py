@@ -15,13 +15,7 @@ from typing import List, Optional, Sequence, Tuple
 from ..data_model import TextDocument
 from ..errors import DocumentFiltered
 from ..executor import ProcessingStep
-from ..utils.text import (
-    find_all_duplicate,
-    find_duplicates,
-    find_top_duplicate,
-    get_n_grams,
-    split_into_words,
-)
+from ..utils.text import find_duplicates, ngram_dup_stats
 from .common import fmt2
 
 __all__ = ["GopherRepetitionFilter"]
@@ -94,18 +88,19 @@ class GopherRepetitionFilter(ProcessingStep):
                 f"max {fmt2(self.dup_line_char_frac)})"
             )
 
-        words = split_into_words(trimmed)
+        top_stats, dup_stats = ngram_dup_stats(
+            trimmed,
+            [n for n, _ in self.top_n_grams],
+            [n for n, _ in self.dup_n_grams],
+        )
 
         for n, thr in self.top_n_grams:
-            n_grams = get_n_grams(words, n)
-            top = find_top_duplicate(n_grams)
-            ratio = top / text_char_len
+            ratio = top_stats[n] / text_char_len
             if n > 0 and ratio > thr:
                 reasons.append(f"top_{n}_gram (ratio {fmt2(ratio)}, max {fmt2(thr)})")
 
         for n, thr in self.dup_n_grams:
-            dup_bytes = find_all_duplicate(words, n)
-            ratio = dup_bytes / text_char_len
+            ratio = dup_stats[n] / text_char_len
             if n > 0 and ratio > thr:
                 reasons.append(
                     f"duplicated_{n}_n_grams (ratio {fmt2(ratio)}, max {fmt2(thr)})"

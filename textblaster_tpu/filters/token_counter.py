@@ -10,7 +10,9 @@ token_counter.rs:14; this build adds offline paths first since TPU pods are
 often egress-less):
 
 1. a local path to a ``tokenizer.json`` file or a directory containing one;
-2. the HuggingFace hub cache / network via ``tokenizers.Tokenizer.from_pretrained``.
+2. a local ``merges.txt`` (GPT-2 byte-level BPE) counted by the native C++
+   core (``textblaster_tpu/native``) — no vocab ids are needed for a count;
+3. the HuggingFace hub cache / network via ``tokenizers.Tokenizer.from_pretrained``.
 
 A load failure raises ``UnexpectedError("Error in loading tokenizer")`` at
 construction, matching the reference's build-time failure surface
@@ -32,23 +34,44 @@ class TokenCounter(ProcessingStep):
     name = "TokenCounter"
 
     def __init__(self, tokenizer_name: str) -> None:
+        self._tokenizer = None
+        self._bpe = None
         try:
-            from tokenizers import Tokenizer
+            json_path = tokenizer_name
+            merges_path = None
+            if os.path.isdir(tokenizer_name):
+                json_path = os.path.join(tokenizer_name, "tokenizer.json")
+                merges_path = os.path.join(tokenizer_name, "merges.txt")
+            elif tokenizer_name.endswith("merges.txt"):
+                json_path = None
+                merges_path = tokenizer_name
+            if json_path is not None and os.path.isfile(json_path):
+                from tokenizers import Tokenizer
 
-            path = tokenizer_name
-            if os.path.isdir(path):
-                path = os.path.join(path, "tokenizer.json")
-            if os.path.isfile(path):
-                self._tokenizer = Tokenizer.from_file(path)
+                self._tokenizer = Tokenizer.from_file(json_path)
+            elif merges_path is not None and os.path.isfile(merges_path):
+                # Byte-level BPE counting on the native core — the egress-less
+                # path (vocab ids are not needed for a token *count*).
+                from ..native import BpeCounter
+
+                self._bpe = BpeCounter.from_file(merges_path)
             else:
+                from tokenizers import Tokenizer
+
                 self._tokenizer = Tokenizer.from_pretrained(tokenizer_name)
         except Exception as e:
             raise UnexpectedError("Error in loading tokenizer") from e
 
     def process(self, document: TextDocument) -> TextDocument:
         try:
-            encoding = self._tokenizer.encode(document.content, add_special_tokens=True)
+            if self._bpe is not None:
+                count = self._bpe.count(document.content)
+            else:
+                encoding = self._tokenizer.encode(
+                    document.content, add_special_tokens=True
+                )
+                count = len(encoding.tokens)
         except Exception as e:
             raise UnexpectedError(str(e)) from e
-        document.metadata["token_count"] = str(len(encoding.tokens))
+        document.metadata["token_count"] = str(count)
         return document

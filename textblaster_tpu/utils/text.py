@@ -37,6 +37,11 @@ import numpy as np
 from .chartables import ALNUM, ALPHA, DIGIT, PUNCT, WS, classify, codepoints
 from .chartables import PUNCTUATION  # re-export for filters  # noqa: F401
 
+try:  # native C++ fast path (lazy-built; None => pure numpy)
+    from ..native import word_spans_native as _native_spans
+except Exception:  # pragma: no cover - import robustness
+    _native_spans = None
+
 __all__ = [
     "DANISH_STOP_WORDS",
     "PUNCTUATION",
@@ -111,11 +116,17 @@ def word_spans(text: str) -> List[Tuple[int, int]]:
     """(start, end) codepoint spans of the word segments of ``text``.
 
     The segments returned correspond 1:1 to ``split_into_words(text)``.
+    Dispatches to the native C++ core when available (identical semantics,
+    asserted by tests/test_native.py); this numpy path is the source of truth.
     """
     if not text:
         return []
     cps = codepoints(text)
     cls = classify(cps)
+    if _native_spans is not None:
+        spans = _native_spans(cps.astype(np.int32), cls)
+        if spans is not None:
+            return [(int(s), int(e)) for s, e in spans]
     in_word = _word_mask(cps, cls)
     n = cps.shape[0]
 
@@ -266,6 +277,36 @@ def find_top_duplicate(items: Sequence[str]) -> int:
     return max(
         _byte_len(gram) * max_count for gram, c in counter.items() if c == max_count
     )
+
+
+def ngram_dup_stats(
+    text: str, top_ns: Sequence[int], dup_ns: Sequence[int]
+) -> Tuple[Dict[int, int], Dict[int, int]]:
+    """Batch n-gram duplicate statistics for one text.
+
+    Returns ``(top, dup)`` where ``top[n]`` = ``find_top_duplicate`` of the
+    space-joined n-grams and ``dup[n]`` = ``find_all_duplicate`` byte sums —
+    the quantities GopherRepetition thresholds (gopher_rep.rs:163-196).
+    Computed by the native core over one shared segmentation when available,
+    else via the Python primitives.
+    """
+    if _native_spans is not None:
+        try:
+            from ..native import available, dup_ngram_bytes, top_ngram_bytes
+        except Exception:  # pragma: no cover
+            available = lambda: False  # noqa: E731
+        if available():
+            cps = codepoints(text).astype(np.int32)
+            cls = classify(cps.astype(np.uint32))
+            spans = _native_spans(cps, cls)
+            if spans is not None:
+                top = {n: top_ngram_bytes(cps, spans, n) for n in top_ns}
+                dup = {n: dup_ngram_bytes(cps, spans, n) for n in dup_ns}
+                return top, dup
+    words = split_into_words(text)
+    top = {n: find_top_duplicate(get_n_grams(words, n)) for n in top_ns}
+    dup = {n: find_all_duplicate(words, n) for n in dup_ns}
+    return top, dup
 
 
 def find_all_duplicate(words: Sequence[str], n: int) -> int:
