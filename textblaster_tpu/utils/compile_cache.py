@@ -1,0 +1,33 @@
+"""Persistent XLA compilation cache setup.
+
+The filter-pipeline programs are large graphs (every filter traced into one
+``jit`` per shape bucket), and remote TPU compiles through the axon tunnel
+take minutes; a persistent on-disk cache makes repeat runs (tests, the
+driver's bench, CLI re-invocations) near-instant.  Shared by ``bench.py``,
+``tests/conftest.py``, and the CLI.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["enable_compilation_cache", "DEFAULT_CACHE_DIR"]
+
+#: Repo-local cache directory (gitignored).
+DEFAULT_CACHE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    ".cache",
+    "jax",
+)
+
+
+def enable_compilation_cache(cache_dir: str | None = None) -> str:
+    """Point JAX's persistent compilation cache at ``cache_dir`` (created if
+    missing).  Returns the directory used."""
+    import jax
+
+    cache_dir = cache_dir or DEFAULT_CACHE_DIR
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    return cache_dir
