@@ -1,0 +1,177 @@
+"""Checkpoint/resume: crash mid-run, resume, outputs identical to one-shot.
+
+The reference cannot do this at all (SURVEY.md §5 "Checkpoint / resume:
+None"); these tests pin the new subsystem's core guarantees: exact-prefix
+cursors, fingerprint mismatch detection, and byte-identical final outputs
+after an injected crash + resume.
+"""
+
+import json
+import os
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from textblaster_tpu.checkpoint import (
+    CHECKPOINT_FILE,
+    CheckpointState,
+    run_checkpointed,
+)
+from textblaster_tpu.config.pipeline import parse_pipeline_config
+from textblaster_tpu.errors import CheckpointError
+from textblaster_tpu.parallel.runner import run_pipeline
+
+CONFIG_YAML = """
+pipeline:
+  - type: GopherQualityFilter
+    min_doc_words: 5
+"""
+
+GOOD = (
+    "This is a sentence with a number of words that is long enough to pass "
+    "the filter easily today."
+)
+BAD = "too short"
+
+
+def _write_input(path, n=50):
+    rows = {
+        "id": [f"doc-{i}" for i in range(n)],
+        "text": [GOOD if i % 3 else BAD for i in range(n)],
+    }
+    pq.write_table(pa.table(rows), path)
+
+
+@pytest.fixture
+def config():
+    return parse_pipeline_config(CONFIG_YAML)
+
+
+def test_single_shot_checkpointed_matches_plain(tmp_path, config):
+    inp = str(tmp_path / "in.parquet")
+    _write_input(inp)
+
+    plain_out = str(tmp_path / "plain_out.parquet")
+    plain_excl = str(tmp_path / "plain_excl.parquet")
+    run_pipeline(config, inp, plain_out, plain_excl, backend="host", quiet=True)
+
+    ck_out = str(tmp_path / "ck_out.parquet")
+    ck_excl = str(tmp_path / "ck_excl.parquet")
+    result = run_checkpointed(
+        config, inp, ck_out, ck_excl,
+        ckpt_dir=str(tmp_path / "ckpt"), chunk_size=16, backend="host",
+    )
+    assert result.received == 50
+    for a, b in ((plain_out, ck_out), (plain_excl, ck_excl)):
+        ta, tb = pq.read_table(a), pq.read_table(b)
+        assert ta.to_pydict() == tb.to_pydict()
+    # Checkpoint dir cleaned up after successful finalize.
+    assert not os.path.exists(tmp_path / "ckpt")
+
+
+def test_crash_and_resume_produces_identical_outputs(tmp_path, config):
+    inp = str(tmp_path / "in.parquet")
+    _write_input(inp)
+
+    plain_out = str(tmp_path / "plain_out.parquet")
+    plain_excl = str(tmp_path / "plain_excl.parquet")
+    run_pipeline(config, inp, plain_out, plain_excl, backend="host", quiet=True)
+
+    out = str(tmp_path / "out.parquet")
+    excl = str(tmp_path / "excl.parquet")
+    ckpt = str(tmp_path / "ckpt")
+
+    # Crash after 2 committed chunks of 12 -> 24 rows consumed.
+    with pytest.raises(CheckpointError, match="fault injection"):
+        run_checkpointed(
+            config, inp, out, excl, ckpt_dir=ckpt, chunk_size=12,
+            backend="host", stop_after_chunks=2,
+        )
+    state = CheckpointState.load(ckpt)
+    assert state is not None and state.rows_consumed == 24
+    assert not os.path.exists(out)  # final outputs not yet written
+
+    # Resume to completion.
+    result = run_checkpointed(
+        config, inp, out, excl, ckpt_dir=ckpt, chunk_size=12, backend="host",
+    )
+    assert result.received == 50
+    for a, b in ((plain_out, out), (plain_excl, excl)):
+        ta, tb = pq.read_table(a), pq.read_table(b)
+        assert ta.to_pydict() == tb.to_pydict()
+    assert not os.path.exists(ckpt)
+
+
+def test_resume_rejects_different_input(tmp_path, config):
+    inp = str(tmp_path / "in.parquet")
+    _write_input(inp)
+    ckpt = str(tmp_path / "ckpt")
+    with pytest.raises(CheckpointError, match="fault injection"):
+        run_checkpointed(
+            config, inp, str(tmp_path / "o.parquet"), str(tmp_path / "e.parquet"),
+            ckpt_dir=ckpt, chunk_size=10, backend="host", stop_after_chunks=1,
+        )
+    _write_input(inp, n=60)  # replace the input
+    with pytest.raises(CheckpointError, match="different input"):
+        run_checkpointed(
+            config, inp, str(tmp_path / "o.parquet"), str(tmp_path / "e.parquet"),
+            ckpt_dir=ckpt, chunk_size=10, backend="host",
+        )
+
+
+def test_resume_rejects_different_config(tmp_path, config):
+    inp = str(tmp_path / "in.parquet")
+    _write_input(inp)
+    ckpt = str(tmp_path / "ckpt")
+    with pytest.raises(CheckpointError, match="fault injection"):
+        run_checkpointed(
+            config, inp, str(tmp_path / "o.parquet"), str(tmp_path / "e.parquet"),
+            ckpt_dir=ckpt, chunk_size=10, backend="host", stop_after_chunks=1,
+        )
+    other = parse_pipeline_config(
+        "pipeline:\n  - type: GopherQualityFilter\n    min_doc_words: 6\n"
+    )
+    with pytest.raises(CheckpointError, match="different .*config"):
+        run_checkpointed(
+            other, inp, str(tmp_path / "o.parquet"), str(tmp_path / "e.parquet"),
+            ckpt_dir=ckpt, chunk_size=10, backend="host",
+        )
+
+
+def test_checkpoint_file_is_valid_json_cursor(tmp_path, config):
+    inp = str(tmp_path / "in.parquet")
+    _write_input(inp)
+    ckpt = str(tmp_path / "ckpt")
+    with pytest.raises(CheckpointError):
+        run_checkpointed(
+            config, inp, str(tmp_path / "o.parquet"), str(tmp_path / "e.parquet"),
+            ckpt_dir=ckpt, chunk_size=20, backend="host", stop_after_chunks=1,
+        )
+    with open(os.path.join(ckpt, CHECKPOINT_FILE)) as f:
+        d = json.load(f)
+    assert d["rows_consumed"] == 20
+    assert d["received"] == 20
+    assert d["input"]["num_rows"] == 50
+    assert all(os.path.exists(os.path.join(ckpt, p)) for p in d["out_parts"])
+
+
+def test_device_backend_checkpointed(tmp_path, config):
+    # Chunk boundaries are device-batch flush barriers; the compiled pipeline
+    # is reused across chunks.
+    inp = str(tmp_path / "in.parquet")
+    _write_input(inp, n=30)
+    out = str(tmp_path / "out.parquet")
+    excl = str(tmp_path / "excl.parquet")
+    result = run_checkpointed(
+        config, inp, out, excl, ckpt_dir=str(tmp_path / "ckpt"),
+        chunk_size=8, backend="tpu", device_batch=8,
+    )
+    assert result.received == 30
+    plain_out = str(tmp_path / "p_out.parquet")
+    plain_excl = str(tmp_path / "p_excl.parquet")
+    run_pipeline(config, inp, plain_out, plain_excl, backend="host", quiet=True)
+    assert (
+        pq.read_table(out).to_pydict()["id"]
+        == pq.read_table(plain_out).to_pydict()["id"]
+    )

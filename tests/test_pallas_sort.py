@@ -1,0 +1,77 @@
+"""Pallas bitonic sort vs lax.sort oracle (interpret mode on CPU).
+
+The TPU analogue of the reference's text-primitive unit tests
+(utils/text.rs:261-467): the sort underlies every duplicate statistic, so its
+semantics are pinned against XLA's lexicographic sort on randomized and
+adversarial inputs.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from textblaster_tpu.ops.pallas_sort import _ROWS, pallas_sort3, sort3
+
+
+def _oracle(k1, k2, k3):
+    return jax.lax.sort(
+        (jnp.asarray(k1), jnp.asarray(k2), jnp.asarray(k3)),
+        dimension=1,
+        num_keys=3,
+    )
+
+
+def _check(k1, k2, k3):
+    got = pallas_sort3(jnp.asarray(k1), jnp.asarray(k2), jnp.asarray(k3),
+                       interpret=True)
+    want = _oracle(k1, k2, k3)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+@pytest.mark.parametrize("m", [128, 256, 1024])
+def test_random_rows(m):
+    rng = np.random.default_rng(m)
+    k1 = rng.integers(0, 2, size=(_ROWS, m)).astype(np.int32)
+    k2 = rng.integers(-(2**31), 2**31, size=(_ROWS, m)).astype(np.int32)
+    k3 = rng.integers(0, 50, size=(_ROWS, m)).astype(np.int32)
+    _check(k1, k2, k3)
+
+
+def test_duplicate_heavy_keys():
+    # Few distinct hashes -> long equal runs; ties must resolve by later keys.
+    rng = np.random.default_rng(7)
+    m = 256
+    k1 = np.zeros((_ROWS, m), np.int32)
+    k2 = rng.integers(0, 4, size=(_ROWS, m)).astype(np.int32)
+    k3 = rng.integers(0, 3, size=(_ROWS, m)).astype(np.int32)
+    _check(k1, k2, k3)
+
+
+def test_presorted_and_reversed():
+    m = 128
+    asc = np.tile(np.arange(m, dtype=np.int32), (_ROWS, 1))
+    _check(np.zeros_like(asc), asc, asc)
+    _check(np.zeros_like(asc), asc[:, ::-1].copy(), asc)
+
+
+def test_multi_block_grid():
+    rng = np.random.default_rng(3)
+    b, m = _ROWS * 3, 128
+    k1 = rng.integers(0, 2, size=(b, m)).astype(np.int32)
+    k2 = rng.integers(0, 1000, size=(b, m)).astype(np.int32)
+    k3 = rng.integers(0, 1000, size=(b, m)).astype(np.int32)
+    _check(k1, k2, k3)
+
+
+def test_sort3_dispatch_cpu_fallback():
+    # On the CPU backend sort3 must route to lax.sort and agree with it.
+    rng = np.random.default_rng(11)
+    k1 = rng.integers(0, 2, size=(_ROWS, 128)).astype(np.int32)
+    k2 = rng.integers(0, 99, size=(_ROWS, 128)).astype(np.int32)
+    k3 = rng.integers(0, 99, size=(_ROWS, 128)).astype(np.int32)
+    got = sort3(jnp.asarray(k1), jnp.asarray(k2), jnp.asarray(k3))
+    want = _oracle(k1, k2, k3)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))

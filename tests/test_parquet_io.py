@@ -171,3 +171,28 @@ def test_write_after_close_raises(tmp_path):
     w.close()
     with pytest.raises(PipelineError):
         w.write_batch([TextDocument(id="y", content="c", source="s")])
+
+
+def test_skip_rows_seeks_past_row_groups(tmp_path):
+    """Resume cursor: skip_rows must seek at row-group granularity and
+    produce exactly the suffix of the full stream."""
+    path = str(tmp_path / "multi_rg.parquet")
+    ids = [f"r{i}" for i in range(25)]
+    texts = [f"text number {i}" for i in range(25)]
+    # 5-row row groups.
+    writer = pq.ParquetWriter(path, pa.schema([("id", pa.string()), ("text", pa.string())]))
+    for start in range(0, 25, 5):
+        writer.write_table(
+            pa.table({"id": ids[start:start + 5], "text": texts[start:start + 5]})
+        )
+    writer.close()
+    assert pq.ParquetFile(path).metadata.num_row_groups == 5
+
+    reader = ParquetReader(
+        ParquetInputConfig(path=path, text_column="text", id_column="id", batch_size=4)
+    )
+    full = [d.id for d in reader.read_documents()]
+    assert full == ids
+    for skip in (0, 3, 5, 7, 20, 24, 25, 30):
+        got = [d.id for d in reader.read_documents(skip_rows=skip)]
+        assert got == ids[skip:], f"skip={skip}"

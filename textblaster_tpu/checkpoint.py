@@ -1,0 +1,335 @@
+"""Checkpoint / resume for pipeline runs.
+
+The reference has **no** checkpointing: producer state is in-memory and a
+crash restarts the whole shard (an explicit roadmap gap — SURVEY.md §5
+"Checkpoint / resume: None ... The TPU build should do better (resumable
+row-group cursor)").  This subsystem closes that gap:
+
+* the run is processed in **chunks** of documents; after each chunk the kept
+  and excluded rows land in per-chunk Parquet part files and a JSON cursor
+  (consumed-row count, outcome counts, part list, input + config
+  fingerprints) is committed atomically (tmp + rename);
+* a restart after a crash re-opens the cursor, verifies the fingerprints,
+  skips the consumed prefix of the reader stream, and continues from the
+  next chunk — completed work is never recomputed (and with the persistent
+  XLA compilation cache the restart does not even recompile);
+* at stream end the parts concatenate into the reference-shaped single
+  kept/excluded Parquet pair (parquet_writer.rs:17-44 schema) and the
+  checkpoint directory is removed.
+
+Chunk boundaries are also device-batch flush barriers, so the consumed
+prefix exactly matches the set of produced outcomes — the property the
+cursor relies on (the bucketed packer holds partial batches *within* a
+chunk, never across a checkpoint).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import logging
+import os
+import shutil
+from dataclasses import dataclass, field
+from itertools import islice
+from typing import Callable, Iterator, List, Optional
+
+import pyarrow.parquet as pq
+
+from .config.pipeline import PipelineConfig
+from .data_model import ProcessingOutcome
+from .errors import CheckpointError, PipelineError
+from .io.parquet_writer import OUTPUT_SCHEMA, ParquetWriter
+from .orchestration import (
+    PARQUET_WRITE_BATCH_SIZE,
+    AggregationResult,
+    read_documents,
+)
+from .utils.metrics import METRICS
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["CheckpointState", "run_checkpointed", "CHECKPOINT_FILE"]
+
+CHECKPOINT_FILE = "checkpoint.json"
+_VERSION = 1
+
+
+def _input_fingerprint(path: str) -> dict:
+    st = os.stat(path)
+    meta = pq.read_metadata(path)
+    return {
+        "path": os.path.abspath(path),
+        "size": st.st_size,
+        "mtime_ns": st.st_mtime_ns,
+        "num_rows": meta.num_rows,
+    }
+
+
+def _config_fingerprint(config: PipelineConfig) -> str:
+    spec = [
+        {"type": s.type, "params": dataclasses.asdict(s.params)}
+        for s in config.pipeline
+    ]
+    blob = json.dumps(spec, sort_keys=True, default=str).encode("utf-8")
+    return hashlib.sha256(blob).hexdigest()
+
+
+@dataclass
+class CheckpointState:
+    """The resumable cursor, serialized to ``<dir>/checkpoint.json``."""
+
+    input: dict
+    config_hash: str
+    rows_consumed: int = 0
+    read_errors: int = 0
+    received: int = 0
+    success: int = 0
+    filtered: int = 0
+    errors: int = 0
+    out_parts: List[str] = field(default_factory=list)
+    excl_parts: List[str] = field(default_factory=list)
+    version: int = _VERSION
+
+    def save(self, ckpt_dir: str) -> None:
+        tmp = os.path.join(ckpt_dir, CHECKPOINT_FILE + ".tmp")
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(dataclasses.asdict(self), f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(ckpt_dir, CHECKPOINT_FILE))
+
+    @classmethod
+    def load(cls, ckpt_dir: str) -> Optional["CheckpointState"]:
+        path = os.path.join(ckpt_dir, CHECKPOINT_FILE)
+        if not os.path.exists(path):
+            return None
+        with open(path, encoding="utf-8") as f:
+            d = json.load(f)
+        if d.get("version") != _VERSION:
+            raise CheckpointError(
+                f"checkpoint version {d.get('version')} is not supported"
+            )
+        return cls(**d)
+
+
+class _PartWriter:
+    """Lazily-created Parquet part files, one per checkpointed chunk.
+
+    Documents buffer to ``PARQUET_WRITE_BATCH_SIZE`` before hitting the
+    writer (producer_logic.rs:21 parity) so each part gets a few large row
+    groups instead of one per document.
+    """
+
+    def __init__(self, ckpt_dir: str, prefix: str, existing: List[str]) -> None:
+        self.ckpt_dir = ckpt_dir
+        self.prefix = prefix
+        self.parts = list(existing)
+        self._writer: Optional[ParquetWriter] = None
+        self._current: Optional[str] = None
+        self._buffer: List = []
+
+    def append(self, doc) -> None:
+        self._buffer.append(doc)
+        if len(self._buffer) >= PARQUET_WRITE_BATCH_SIZE:
+            self._flush()
+
+    def _flush(self) -> None:
+        if not self._buffer:
+            return
+        if self._writer is None:
+            name = f"{self.prefix}-{len(self.parts):05d}.parquet"
+            self._current = name
+            self._writer = ParquetWriter(os.path.join(self.ckpt_dir, name))
+        self._writer.write_batch(self._buffer)
+        self._buffer.clear()
+
+    def roll(self) -> None:
+        """Flush and close the current part (if any) at a chunk boundary."""
+        self._flush()
+        if self._writer is not None:
+            self._writer.close()
+            self.parts.append(self._current)
+            self._writer = None
+            self._current = None
+
+    def abort(self) -> None:
+        self._buffer.clear()
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+            # The part is NOT recorded: a crash mid-chunk discards it and the
+            # resume reprocesses the whole chunk.
+
+
+def _concat_parts(ckpt_dir: str, parts: List[str], out_path: str) -> None:
+    parent = os.path.dirname(out_path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    writer = pq.ParquetWriter(out_path, OUTPUT_SCHEMA)
+    try:
+        for name in parts:
+            table = pq.read_table(os.path.join(ckpt_dir, name))
+            if table.num_rows:
+                writer.write_table(table.cast(OUTPUT_SCHEMA))
+    finally:
+        writer.close()
+
+
+def run_checkpointed(
+    config: PipelineConfig,
+    input_file: str,
+    output_file: str,
+    excluded_file: str,
+    ckpt_dir: str,
+    chunk_size: int = 8192,
+    text_column: str = "text",
+    id_column: str = "id",
+    backend: str = "tpu",
+    read_batch_size: int = 1024,
+    device_batch: Optional[int] = None,
+    mesh=None,
+    progress: Optional[Callable[[AggregationResult], None]] = None,
+    stop_after_chunks: Optional[int] = None,
+) -> AggregationResult:
+    """Run the pipeline with chunk-level checkpointing (resume by default).
+
+    ``stop_after_chunks`` aborts the run after N committed chunks — the fault
+    -injection hook the crash/resume tests drive (the reference's only analogue
+    is fake failing steps, SURVEY.md §5 "no fault injection framework").
+    """
+    os.makedirs(ckpt_dir, exist_ok=True)
+    fingerprint = _input_fingerprint(input_file)
+    config_hash = _config_fingerprint(config)
+
+    state = CheckpointState.load(ckpt_dir)
+    if state is not None:
+        if state.input != fingerprint:
+            raise CheckpointError(
+                f"checkpoint in '{ckpt_dir}' was created for a different input "
+                f"({state.input.get('path')}, {state.input.get('num_rows')} rows); "
+                "remove the checkpoint directory to start over"
+            )
+        if state.config_hash != config_hash:
+            raise CheckpointError(
+                f"checkpoint in '{ckpt_dir}' was created with a different "
+                "pipeline config; remove the checkpoint directory to start over"
+            )
+        logger.info(
+            "Resuming from checkpoint: %d rows consumed, %d outcomes",
+            state.rows_consumed,
+            state.received,
+        )
+    else:
+        state = CheckpointState(input=fingerprint, config_hash=config_hash)
+
+    out_parts = _PartWriter(ckpt_dir, "out", state.out_parts)
+    excl_parts = _PartWriter(ckpt_dir, "excl", state.excl_parts)
+
+    read_errors_box = [state.read_errors]
+
+    def on_read_error(_err) -> None:
+        read_errors_box[0] += 1
+
+    # The raw reader stream yields one item per row (document or per-row
+    # error) — `rows_consumed` counts items, so the skip is exact.  The
+    # consumed prefix is skipped at row-group granularity (never decoded).
+    raw = read_documents(
+        input_file,
+        text_column=text_column,
+        id_column=id_column,
+        batch_size=read_batch_size,
+        skip_rows=state.rows_consumed,
+    )
+
+    # Chunk processor: host executor or a single CompiledPipeline reused
+    # across chunks (compiled programs cached between calls).
+    if backend == "tpu":
+        import jax
+
+        from .ops.pipeline import CompiledPipeline, process_documents_device
+        from .parallel.mesh import data_mesh
+
+        if mesh is None and len(jax.devices()) > 1:
+            mesh = data_mesh()  # same sharding as the non-checkpointed runner
+        pipeline = CompiledPipeline(
+            config, batch_size=device_batch or 256, mesh=mesh
+        )
+
+        def process_chunk(items) -> Iterator[ProcessingOutcome]:
+            return process_documents_device(
+                config, items, on_read_error=on_read_error, pipeline=pipeline
+            )
+
+    else:
+        from .orchestration import process_documents_host
+        from .pipeline_builder import build_pipeline_from_config
+
+        executor = build_pipeline_from_config(config)
+
+        def process_chunk(items) -> Iterator[ProcessingOutcome]:
+            return process_documents_host(
+                executor, items, on_read_error=on_read_error
+            )
+
+    result = AggregationResult(
+        received=state.received,
+        success=state.success,
+        filtered=state.filtered,
+        errors=state.errors,
+    )
+
+    chunks_done = 0
+    try:
+        while True:
+            chunk = list(islice(raw, chunk_size))
+            if not chunk:
+                break
+            for outcome in process_chunk(iter(chunk)):
+                result.received += 1
+                if outcome.kind == ProcessingOutcome.SUCCESS:
+                    result.success += 1
+                    METRICS.inc("producer_results_success_total")
+                    out_parts.append(outcome.document)
+                elif outcome.kind == ProcessingOutcome.FILTERED:
+                    result.filtered += 1
+                    METRICS.inc("producer_results_filtered_total")
+                    excl_parts.append(outcome.document)
+                else:
+                    result.errors += 1
+                    METRICS.inc("producer_results_error_total")
+                METRICS.inc("producer_results_received_total")
+                if progress is not None:
+                    progress(result)
+
+            # Chunk boundary: commit parts, then the cursor.
+            out_parts.roll()
+            excl_parts.roll()
+            state.rows_consumed += len(chunk)
+            state.read_errors = read_errors_box[0]
+            state.received = result.received
+            state.success = result.success
+            state.filtered = result.filtered
+            state.errors = result.errors
+            state.out_parts = out_parts.parts
+            state.excl_parts = excl_parts.parts
+            state.save(ckpt_dir)
+
+            chunks_done += 1
+            if stop_after_chunks is not None and chunks_done >= stop_after_chunks:
+                raise CheckpointError(
+                    f"aborted after {chunks_done} chunks (fault injection)"
+                )
+    except BaseException:
+        out_parts.abort()
+        excl_parts.abort()
+        raise
+
+    # Finalize: single kept/excluded pair with the reference's schema.
+    _concat_parts(ckpt_dir, state.out_parts, output_file)
+    _concat_parts(ckpt_dir, state.excl_parts, excluded_file)
+    shutil.rmtree(ckpt_dir)
+
+    result.read_errors = read_errors_box[0]
+    return result

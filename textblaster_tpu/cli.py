@@ -58,6 +58,12 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--metrics-port", type=int, default=None,
                      help="Port for the Prometheus metrics HTTP endpoint")
     run.add_argument("--quiet", action="store_true", help="Suppress progress output")
+    run.add_argument("--checkpoint-dir", default=None,
+                     help="Enable chunk-level checkpointing in this directory; "
+                          "an interrupted run resumes from the last committed "
+                          "chunk (the reference cannot do this)")
+    run.add_argument("--checkpoint-every", type=int, default=8192,
+                     help="Documents per checkpointed chunk")
 
     val = sub.add_parser("validate-config",
                          help="Validate a pipeline configuration and exit")
@@ -86,6 +92,14 @@ def _cmd_run(args: argparse.Namespace) -> int:
     init_logging("textblast")
     setup_prometheus_metrics(args.metrics_port)
 
+    if args.backend == "tpu":
+        # Large traced pipelines + (possibly remote) TPU compiles: persist
+        # compiled programs so re-runs and checkpoint resumes skip the
+        # compile entirely.
+        from .utils.compile_cache import enable_compilation_cache
+
+        enable_compilation_cache()
+
     try:
         config = load_pipeline_config(args.pipeline_config)
     except PipelineError as e:
@@ -94,21 +108,42 @@ def _cmd_run(args: argparse.Namespace) -> int:
 
     start = time.perf_counter()
 
-    from .parallel.runner import run_pipeline
-
     try:
-        result = run_pipeline(
-            config=config,
-            input_file=args.input_file,
-            output_file=args.output_file,
-            excluded_file=args.excluded_file,
-            text_column=args.text_column,
-            id_column=args.id_column,
-            backend=args.backend,
-            read_batch_size=args.batch_size,
-            device_batch=args.device_batch,
-            quiet=args.quiet,
-        )
+        if args.checkpoint_dir:
+            from .checkpoint import run_checkpointed
+            from .parallel.runner import _Progress
+
+            progress = _Progress(enabled=not args.quiet)
+            result = run_checkpointed(
+                config=config,
+                input_file=args.input_file,
+                output_file=args.output_file,
+                excluded_file=args.excluded_file,
+                ckpt_dir=args.checkpoint_dir,
+                chunk_size=args.checkpoint_every,
+                text_column=args.text_column,
+                id_column=args.id_column,
+                backend=args.backend,
+                read_batch_size=args.batch_size,
+                device_batch=args.device_batch,
+                progress=progress.update,
+            )
+            progress.finish()
+        else:
+            from .parallel.runner import run_pipeline
+
+            result = run_pipeline(
+                config=config,
+                input_file=args.input_file,
+                output_file=args.output_file,
+                excluded_file=args.excluded_file,
+                text_column=args.text_column,
+                id_column=args.id_column,
+                backend=args.backend,
+                read_batch_size=args.batch_size,
+                device_batch=args.device_batch,
+                quiet=args.quiet,
+            )
     except PipelineError as e:
         print(f"Pipeline run failed: {e}", file=sys.stderr)
         return 1

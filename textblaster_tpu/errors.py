@@ -25,6 +25,7 @@ __all__ = [
     "QueueError",
     "SerializationError",
     "UnexpectedError",
+    "CheckpointError",
 ]
 
 
@@ -107,3 +108,11 @@ class UnexpectedError(PipelineError):
 
     def __str__(self) -> str:
         return f"Unexpected error: {self.args[0] if self.args else ''}"
+
+
+class CheckpointError(PipelineError):
+    """Checkpoint/resume cursor error (no reference equivalent — the
+    reference has no checkpointing, SURVEY.md §5)."""
+
+    def __str__(self) -> str:
+        return f"Checkpoint error: {self.args[0] if self.args else ''}"

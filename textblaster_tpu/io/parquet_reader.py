@@ -98,14 +98,39 @@ class ParquetReader(BaseReader):
                 f"found: {text_type}"
             )
 
-    def read_batches(self) -> Iterator[pa.RecordBatch]:
-        """Raw Arrow record batches (the zero-copy path for the TPU packer)."""
+    def read_batches(self, skip_rows: int = 0) -> Iterator[pa.RecordBatch]:
+        """Raw Arrow record batches (the zero-copy path for the TPU packer).
+
+        ``skip_rows`` seeks past the first N rows without decoding them:
+        fully-consumed row groups are never read (their ``num_rows`` come
+        from the footer), and only the partially-consumed group is sliced —
+        the row-group cursor the checkpoint subsystem resumes from.
+        """
         pf = self._open()
         self._validate_schema(pf.schema_arrow)
         batch_size = self.config.batch_size or 1024
-        yield from pf.iter_batches(batch_size=batch_size)
 
-    def read_documents(self) -> Iterator[Union[TextDocument, PipelineError]]:
+        if skip_rows <= 0:
+            yield from pf.iter_batches(batch_size=batch_size)
+            return
+
+        md = pf.metadata
+        groups = list(range(md.num_row_groups))
+        while groups and skip_rows >= md.row_group(groups[0]).num_rows:
+            skip_rows -= md.row_group(groups[0]).num_rows
+            groups.pop(0)
+        for batch in pf.iter_batches(batch_size=batch_size, row_groups=groups):
+            if skip_rows:
+                if batch.num_rows <= skip_rows:
+                    skip_rows -= batch.num_rows
+                    continue
+                batch = batch.slice(skip_rows)
+                skip_rows = 0
+            yield batch
+
+    def read_documents(
+        self, skip_rows: int = 0
+    ) -> Iterator[Union[TextDocument, PipelineError]]:
         pf = self._open()
         schema = pf.schema_arrow
         self._validate_schema(schema)
@@ -118,7 +143,7 @@ class ParquetReader(BaseReader):
             if md_type not in (pa.string(), pa.large_string()):
                 has["metadata"] = False
 
-        for batch in self.read_batches():
+        for batch in self.read_batches(skip_rows=skip_rows):
             cols = {name: batch.column(i) for i, name in enumerate(batch.schema.names)}
             text_col = cols[self.config.text_column]
             id_col = cols[self.config.id_column]

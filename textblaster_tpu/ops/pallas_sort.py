@@ -1,0 +1,149 @@
+"""VMEM-resident lexicographic sort — the pipeline's hottest device primitive.
+
+Every duplicate-detection statistic (GopherRepetition line/paragraph/n-gram
+dups, FineWeb duplicate lines — gopher_rep.rs:86-196, fineweb_quality.rs:
+149-185 equivalents) reduces to "sort per-row (validity, hash, payload)
+triples along the row".  XLA's ``lax.sort`` runs its compare-exchange network
+with HBM round-trips between passes; this Pallas kernel keeps each block of
+rows resident in VMEM for the entire bitonic network, so the ~log²(m)/2
+stages cost lane-shuffles (``pltpu.roll``) and VPU selects instead of HBM
+bandwidth.
+
+The network is a standard bitonic sorter: static Python loops over
+``(size, stride)`` stages — everything unrolls at trace time, all shapes
+static, no gathers (partner access is a pair of circular lane shifts selected
+by a constant parity mask), which keeps the kernel inside Mosaic's supported
+op set.
+
+Rows are independent; the grid tiles the batch dimension.  Row length must be
+a power of two (all duplicate tables in :mod:`.stats` are sized to powers of
+two by ``pipeline._table_sizes``).
+
+``sort3()`` transparently falls back to ``lax.sort`` off-TPU or if the Pallas
+lowering probe fails, so CPU tests and degraded environments keep working.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu is importable on all platforms; lowering is TPU-only.
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["sort3", "pallas_sort3", "pallas_sort_supported"]
+
+_ROWS = 8  # sublane tile for int32
+
+
+def _lex_gt(a: Tuple[jax.Array, ...], b: Tuple[jax.Array, ...]) -> jax.Array:
+    """Elementwise lexicographic ``a > b`` over equal-length key tuples."""
+    gt = a[-1] > b[-1]
+    for x, y in zip(reversed(a[:-1]), reversed(b[:-1])):
+        gt = (x > y) | ((x == y) & gt)
+    return gt
+
+
+def _bitonic_kernel(k1_ref, k2_ref, k3_ref, o1_ref, o2_ref, o3_ref):
+    m = k1_ref.shape[-1]
+    ks = (k1_ref[:], k2_ref[:], k3_ref[:])
+
+    # In-kernel lane index (Pallas kernels cannot capture host constants).
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, m), 1)
+    size = 2
+    while size <= m:
+        stride = size // 2
+        while stride >= 1:
+            # Per-lane masks for this stage (stage parameters are static).
+            is_lower = (lane & stride) == 0  # partner is at i+stride
+            asc = (lane & size) == 0
+
+            # pltpu.roll requires non-negative shifts; left-roll by `stride`
+            # is a right-roll by `m - stride`.
+            partners = tuple(
+                jnp.where(
+                    is_lower,
+                    pltpu.roll(k, shift=m - stride, axis=1),
+                    pltpu.roll(k, shift=stride, axis=1),
+                )
+                for k in ks
+            )
+            lower = tuple(jnp.where(is_lower, k, p) for k, p in zip(ks, partners))
+            upper = tuple(jnp.where(is_lower, p, k) for k, p in zip(ks, partners))
+            swap = jnp.where(
+                asc, _lex_gt(lower, upper), _lex_gt(upper, lower)
+            )
+            ks = tuple(jnp.where(swap, p, k) for k, p in zip(ks, partners))
+            stride //= 2
+        size *= 2
+
+    o1_ref[:], o2_ref[:], o3_ref[:] = ks
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def pallas_sort3(
+    k1: jax.Array, k2: jax.Array, k3: jax.Array, interpret: bool = False
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Row-wise ascending lexicographic sort of ``(k1, k2, k3)`` (int32
+    ``[B, m]``, ``m`` a power of two, ``B`` a multiple of 8)."""
+    b, m = k1.shape
+    if m & (m - 1):
+        raise ValueError(f"row length {m} is not a power of two")
+    if b % _ROWS:
+        raise ValueError(f"batch {b} is not a multiple of {_ROWS}")
+    spec = pl.BlockSpec((_ROWS, m), lambda i: (i, 0))
+    shape = jax.ShapeDtypeStruct((b, m), jnp.int32)
+    return pl.pallas_call(
+        _bitonic_kernel,
+        grid=(b // _ROWS,),
+        in_specs=[spec, spec, spec],
+        out_specs=[spec, spec, spec],
+        out_shape=[shape, shape, shape],
+        interpret=interpret,
+    )(k1.astype(jnp.int32), k2.astype(jnp.int32), k3.astype(jnp.int32))
+
+
+@functools.lru_cache(maxsize=1)
+def pallas_sort_supported() -> bool:
+    """Probe whether the Pallas kernel lowers and runs on this backend."""
+    if os.environ.get("TEXTBLAST_NO_PALLAS"):
+        return False
+    if pltpu is None or jax.default_backend() == "cpu":
+        return False
+    try:
+        x = jnp.zeros((_ROWS, 128), jnp.int32)
+        jax.block_until_ready(pallas_sort3(x, x, x))
+        return True
+    except Exception as e:  # pragma: no cover - backend-specific
+        logger.warning("Pallas sort unavailable on %s: %s", jax.default_backend(), e)
+        return False
+
+
+def sort3(
+    k1: jax.Array, k2: jax.Array, k3: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Lexicographic row sort: Pallas bitonic network on TPU, ``lax.sort``
+    elsewhere."""
+    b, m = k1.shape
+    if (
+        pallas_sort_supported()
+        and m >= 128
+        and not (m & (m - 1))
+        and b % _ROWS == 0
+    ):
+        return pallas_sort3(k1, k2, k3)
+    return jax.lax.sort(
+        (k1.astype(jnp.int32), k2.astype(jnp.int32), k3.astype(jnp.int32)),
+        dimension=1,
+        num_keys=3,
+    )

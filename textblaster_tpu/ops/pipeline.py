@@ -643,12 +643,18 @@ def process_documents_device(
     on_read_error=None,
     buckets=DEFAULT_BUCKETS,
     mesh=None,
+    pipeline: Optional[CompiledPipeline] = None,
 ) -> Iterator[ProcessingOutcome]:
     """Device-backed processing loop: packs the stream into bucketed batches,
-    runs the compiled pipeline, assembles outcomes in input order per batch."""
-    pipeline = CompiledPipeline(
-        config, buckets=buckets, batch_size=device_batch or 256, mesh=mesh
-    )
+    runs the compiled pipeline, assembles outcomes in input order per batch.
+
+    Pass a prebuilt ``pipeline`` to reuse its compiled programs across
+    multiple streams (the checkpointed runner processes one chunk per call)."""
+    if pipeline is None:
+        pipeline = CompiledPipeline(
+            config, buckets=buckets, batch_size=device_batch or 256, mesh=mesh
+        )
+    buckets = pipeline.buckets
 
     if pipeline.fully_host or not pipeline.device_steps:
         if pipeline.device_steps and pipeline.fully_host:

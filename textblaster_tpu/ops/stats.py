@@ -52,6 +52,7 @@ from .device import (
     word_mask,
 )
 from .dfa import citation_spans, dfa_states
+from .pallas_sort import sort3
 
 __all__ = [
     "TextStructure",
@@ -272,18 +273,15 @@ def _last_nonws_in_line(nonws: jax.Array, li: LineInfo, mask: jax.Array) -> jax.
 
 
 # --- Duplicate counting over (hash, bytes) tables ----------------------------
-# Sorting uses lax.sort's lexicographic multi-operand mode so every key stays
-# int32 (JAX x64 mode is off, and int32 sorts are faster on TPU anyway).
+# Lexicographic (validity, hash, payload) sort: the VMEM-resident Pallas
+# bitonic network on TPU, lax.sort elsewhere (:mod:`.pallas_sort`).  Every key
+# stays int32 (JAX x64 mode is off, and int32 sorts are faster on TPU anyway).
 # Invalid slots carry a leading 1 key, sorting them past all real segments.
 
 
 def _sort_triple(seg_hash, second, seg_valid):
     invalid = (~seg_valid).astype(jnp.int32)
-    s_invalid, s_hash, s_second = jax.lax.sort(
-        (invalid, seg_hash.astype(jnp.int32), second.astype(jnp.int32)),
-        dimension=1,
-        num_keys=3,
-    )
+    s_invalid, s_hash, s_second = sort3(invalid, seg_hash, second)
     return s_invalid == 0, s_hash, s_second
 
 

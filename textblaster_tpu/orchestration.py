@@ -58,9 +58,11 @@ def read_documents(
     text_column: str = "text",
     id_column: str = "id",
     batch_size: int = DEFAULT_READ_BATCH_SIZE,
+    skip_rows: int = 0,
 ) -> Iterator[Union[TextDocument, PipelineError]]:
     """Stream documents off disk (publish_tasks' reading half,
-    producer_logic.rs:30-44)."""
+    producer_logic.rs:30-44).  ``skip_rows`` seeks past committed work on
+    resume without decoding it (row-group cursor)."""
     reader = ParquetReader(
         ParquetInputConfig(
             path=input_file,
@@ -69,7 +71,7 @@ def read_documents(
             batch_size=batch_size,
         )
     )
-    return reader.read_documents()
+    return reader.read_documents(skip_rows=skip_rows)
 
 
 def execute_processing_pipeline(
