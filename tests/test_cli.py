@@ -1,0 +1,143 @@
+"""CLI argument parsing + command behavior.
+
+The analogue of the reference's clap-parsing tests
+(tests/producer_tests.rs:1-98: all args, defaults, missing required, bad
+types) plus the worker's --validate-config fast path (bin/worker.rs:29-51).
+"""
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from textblaster_tpu.cli import build_parser, main
+
+
+def test_run_all_args_parse():
+    args = build_parser().parse_args(
+        [
+            "run",
+            "-i", "in.parquet",
+            "--text-column", "body",
+            "--id-column", "doc_id",
+            "-c", "cfg.yaml",
+            "-o", "out.parquet",
+            "-e", "excl.parquet",
+            "--backend", "host",
+            "--batch-size", "512",
+            "--device-batch", "128",
+            "--metrics-port", "9091",
+            "--quiet",
+            "--checkpoint-dir", "/tmp/ck",
+            "--checkpoint-every", "1000",
+        ]
+    )
+    assert args.command == "run"
+    assert args.input_file == "in.parquet"
+    assert args.text_column == "body"
+    assert args.id_column == "doc_id"
+    assert args.pipeline_config == "cfg.yaml"
+    assert args.output_file == "out.parquet"
+    assert args.excluded_file == "excl.parquet"
+    assert args.backend == "host"
+    assert args.batch_size == 512
+    assert args.device_batch == 128
+    assert args.metrics_port == 9091
+    assert args.quiet is True
+    assert args.checkpoint_dir == "/tmp/ck"
+    assert args.checkpoint_every == 1000
+
+
+def test_run_defaults():
+    args = build_parser().parse_args(["run", "-i", "x.parquet"])
+    assert args.text_column == "text"
+    assert args.id_column == "id"
+    assert args.output_file == "output_processed.parquet"
+    assert args.excluded_file == "excluded.parquet"
+    assert args.backend == "tpu"
+    assert args.batch_size == 1024
+    assert args.device_batch is None
+    assert args.metrics_port is None
+    assert args.quiet is False
+    assert args.checkpoint_dir is None
+
+
+def test_run_missing_required_input():
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["run"])
+
+
+def test_run_bad_int_type():
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["run", "-i", "x", "--batch-size", "abc"])
+
+
+def test_run_bad_backend_choice():
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["run", "-i", "x", "--backend", "gpu"])
+
+
+def test_missing_subcommand():
+    with pytest.raises(SystemExit):
+        build_parser().parse_args([])
+
+
+def test_validate_config_valid(tmp_path, capsys):
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text("pipeline:\n  - type: GopherQualityFilter\n    min_doc_words: 5\n")
+    assert main(["validate-config", "-c", str(cfg)]) == 0
+    assert "is valid" in capsys.readouterr().out
+
+
+def test_validate_config_invalid(tmp_path, capsys):
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text("pipeline:\n  - type: NoSuchFilter\n")
+    assert main(["validate-config", "-c", str(cfg)]) == 1
+    assert "invalid" in capsys.readouterr().err
+
+
+def test_validate_config_missing_file(tmp_path):
+    assert main(["validate-config", "-c", str(tmp_path / "nope.yaml")]) == 1
+
+
+def test_run_host_end_to_end(tmp_path, capsys):
+    inp = tmp_path / "in.parquet"
+    text = (
+        "This is a longer sentence with plenty of words to pass the filter "
+        "in this little test."
+    )
+    pq.write_table(
+        pa.table({"id": ["a", "b"], "text": [text, "nope"]}), str(inp)
+    )
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text("pipeline:\n  - type: GopherQualityFilter\n    min_doc_words: 5\n")
+    out = tmp_path / "out.parquet"
+    excl = tmp_path / "excl.parquet"
+    rc = main(
+        [
+            "run", "-i", str(inp), "-c", str(cfg), "-o", str(out),
+            "-e", str(excl), "--backend", "host", "--quiet",
+        ]
+    )
+    assert rc == 0
+    assert "2 documents" in capsys.readouterr().out
+    assert pq.read_table(str(out)).to_pydict()["id"] == ["a"]
+    assert pq.read_table(str(excl)).to_pydict()["id"] == ["b"]
+
+
+def test_run_bad_config_fails(tmp_path, capsys):
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text("pipeline:\n  - type: NoSuchFilter\n")
+    rc = main(["run", "-i", "whatever.parquet", "-c", str(cfg)])
+    assert rc == 1
+    assert "Failed to load pipeline config" in capsys.readouterr().err
+
+
+def test_run_missing_input_file_fails(tmp_path, capsys):
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text("pipeline:\n  - type: GopherQualityFilter\n    min_doc_words: 5\n")
+    rc = main(
+        ["run", "-i", str(tmp_path / "nope.parquet"), "-c", str(cfg),
+         "--backend", "host", "--quiet"]
+    )
+    assert rc == 1
+    assert "Pipeline run failed" in capsys.readouterr().err

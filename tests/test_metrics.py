@@ -1,0 +1,87 @@
+"""Metrics registry + Prometheus HTTP endpoint.
+
+Mirrors the reference's observability surface
+(utils/prometheus_metrics.rs:16-201): the same metric names, text exposition
+format, an HTTP /metrics endpoint, and non-fatal bind failures.
+"""
+
+import urllib.error
+import urllib.request
+
+from textblaster_tpu.utils.metrics import METRICS, Metrics, setup_prometheus_metrics
+
+
+def test_counter_gauge_histogram_roundtrip():
+    m = Metrics()
+    m.inc("worker_tasks_processed_total")
+    m.inc("worker_tasks_processed_total", 2)
+    assert m.get("worker_tasks_processed_total") == 3
+    m.set("worker_active_tasks", 5)
+    m.dec("worker_active_tasks")
+    assert m.get("worker_active_tasks") == 4
+    m.observe("worker_task_processing_duration_seconds", 0.003)
+    m.observe("worker_task_processing_duration_seconds", 99.0)
+    text = m.render()
+    assert "# TYPE worker_tasks_processed_total counter" in text
+    assert "worker_tasks_processed_total 3" in text
+    assert "# TYPE worker_task_processing_duration_seconds histogram" in text
+    assert 'worker_task_processing_duration_seconds_bucket{le="0.005"} 1' in text
+    assert 'worker_task_processing_duration_seconds_bucket{le="+Inf"} 2' in text
+    assert "worker_task_processing_duration_seconds_count 2" in text
+
+
+def test_render_lists_all_reference_metric_names():
+    text = Metrics().render()
+    for name in (
+        "producer_tasks_published_total",
+        "producer_task_publish_errors_total",
+        "producer_results_received_total",
+        "producer_results_success_total",
+        "producer_results_filtered_total",
+        "producer_results_error_total",
+        "producer_results_deserialization_errors_total",
+        "producer_active_tasks_in_flight",
+        "producer_task_publishing_duration_seconds",
+        "worker_tasks_processed_total",
+        "worker_tasks_filtered_total",
+        "worker_tasks_failed_total",
+        "worker_task_deserialization_errors_total",
+        "worker_outcome_publish_errors_total",
+        "worker_task_processing_duration_seconds",
+        "worker_active_tasks",
+    ):
+        assert name in text
+
+
+def test_http_endpoint_serves_metrics():
+    server = setup_prometheus_metrics(0)  # ephemeral port
+    assert server is not None
+    try:
+        port = server.server_address[1]
+        METRICS.inc("producer_tasks_published_total")
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics") as resp:
+            assert resp.status == 200
+            body = resp.read().decode()
+        assert "producer_tasks_published_total" in body
+        # Non-/metrics paths 404.
+        try:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/other")
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        server.shutdown()
+
+
+def test_no_port_means_no_server():
+    assert setup_prometheus_metrics(None) is None
+
+
+def test_bind_failure_is_nonfatal():
+    s1 = setup_prometheus_metrics(0)
+    assert s1 is not None
+    try:
+        port = s1.server_address[1]
+        assert setup_prometheus_metrics(port) is None  # in use -> logged, None
+    finally:
+        s1.shutdown()

@@ -599,7 +599,11 @@ class CompiledPipeline:
                 kept.append(s)
         doc.content = "\n".join(kept).strip()
 
-    def process_batch(self, batch: PackedBatch) -> List[ProcessingOutcome]:
+    def dispatch_batch(self, batch: PackedBatch) -> Dict[str, jax.Array]:
+        """Launch the compiled program for a batch and return the on-device
+        stats WITHOUT blocking (JAX async dispatch) — the caller overlaps the
+        previous batch's host-side assembly with this batch's device compute
+        (the double-buffered feed SURVEY.md §2.5 maps prefetch/QoS onto)."""
         fn = self._fn_for(batch.max_len)
         if self.mesh is not None:
             from ..parallel.mesh import shard_batch
@@ -607,14 +611,22 @@ class CompiledPipeline:
             cps, lengths = shard_batch(self.mesh, batch.cps, batch.lengths)
         else:
             cps, lengths = batch.cps, batch.lengths
-        device_stats = fn(cps, lengths)
-        stats = {k: np.asarray(v) for k, v in device_stats.items()}
+        return fn(cps, lengths)
 
+    def assemble_batch(
+        self, batch: PackedBatch, device_stats: Dict[str, jax.Array]
+    ) -> List[ProcessingOutcome]:
+        """Blocking half: transfer stats, resolve order/short-circuit/reason
+        strings per document."""
+        stats = {k: np.asarray(v) for k, v in device_stats.items()}
         outcomes: List[ProcessingOutcome] = []
         for row, doc in enumerate(batch.docs):
             outcome = self._assemble(stats, row, doc)
             outcomes.append(outcome)
         return outcomes
+
+    def process_batch(self, batch: PackedBatch) -> List[ProcessingOutcome]:
+        return self.assemble_batch(batch, self.dispatch_batch(batch))
 
     def _assemble(
         self, stats: Dict[str, np.ndarray], row: int, doc: TextDocument
@@ -678,12 +690,20 @@ def process_documents_device(
                 continue
             yield item
 
+    # One batch in flight: dispatch batch k+1 before assembling batch k, so
+    # host-side assembly overlaps device compute.
+    pending: Optional[Tuple[PackedBatch, Dict[str, jax.Array]]] = None
     for batch, fallback in iter_packed_batches(
         doc_stream(), batch_size=pipeline.batch_size, buckets=buckets
     ):
         if batch is not None:
-            yield from pipeline.process_batch(batch)
+            stats = pipeline.dispatch_batch(batch)
+            if pending is not None:
+                yield from pipeline.assemble_batch(*pending)
+            pending = (batch, stats)
         for doc in fallback:
             outcome = execute_processing_pipeline(pipeline.host_executor, doc)
             if outcome is not None:
                 yield outcome
+    if pending is not None:
+        yield from pipeline.assemble_batch(*pending)
