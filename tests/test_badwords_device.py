@@ -1,0 +1,155 @@
+"""C4BadWords device kernel: candidate semantics + end-to-end parity.
+
+The device path must flag every document the reference's alternation regex
+(c4_filters.rs:431-447) would match (no false negatives); the host filter
+then re-verifies flagged documents, so final decisions match the host
+executor exactly.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from textblaster_tpu.config.pipeline import parse_pipeline_config
+from textblaster_tpu.data_model import ProcessingOutcome, TextDocument
+from textblaster_tpu.filters.c4_badwords import load_local_badwords
+from textblaster_tpu.ops.badwords import BadwordTables, badwords_candidates
+from textblaster_tpu.ops.pipeline import CompiledPipeline, process_documents_device
+from textblaster_tpu.orchestration import process_documents_host
+from textblaster_tpu.pipeline_builder import build_pipeline_from_config
+
+
+def _pack(texts, max_len=256):
+    cps = np.zeros((len(texts), max_len), np.int32)
+    lengths = np.zeros(len(texts), np.int32)
+    for i, t in enumerate(texts):
+        arr = np.array([ord(c) for c in t], dtype=np.int32)[:max_len]
+        cps[i, : len(arr)] = arr
+        lengths[i] = len(arr)
+    return jnp.asarray(cps), jnp.asarray(lengths)
+
+
+def test_candidates_with_boundaries():
+    tables = BadwordTables.build(["bad", "wide phrase"], check_boundaries=True)
+    texts = [
+        "this is a bad word here",     # match
+        "BAD at the start",            # case-insensitive match
+        "nothing wrong at all",        # no match
+        "embadded inside a token",     # 'bad' inside a word -> no boundary
+        "badges are fine",             # suffix continues -> no boundary
+        "a wide phrase spans words",   # multi-word pattern
+        "so bad",                      # match at row end
+        "bad",                         # the whole row
+        "",                            # empty row
+    ]
+    got = np.asarray(badwords_candidates(*_pack(texts), tables))
+    assert got.tolist() == [True, True, False, False, False, True, True, True, False]
+
+
+def test_candidates_cjk_no_boundaries():
+    tables = BadwordTables.build(["悪い"], check_boundaries=False)
+    texts = ["これは悪い言葉です", "これは良い言葉です"]
+    got = np.asarray(badwords_candidates(*_pack(texts), tables))
+    assert got.tolist() == [True, False]
+
+
+def test_candidates_superset_of_regex_matches():
+    # Randomized: every regex match must be flagged (no false negatives).
+    import re
+
+    words = ["alpha", "beta gamma", "zz"]
+    tables = BadwordTables.build(words, check_boundaries=True)
+    pattern = re.compile(
+        r"(?i)(?:\W|^)(" + "|".join(re.escape(w) for w in words) + r")(?:\W|$)"
+    )
+    rng = np.random.default_rng(5)
+    vocab = ["alpha", "beta", "gamma", "zz", "the", "dog,", "x", "beta gamma!"]
+    texts = [
+        " ".join(vocab[j] for j in rng.integers(0, len(vocab), size=8))
+        for _ in range(64)
+    ]
+    got = np.asarray(badwords_candidates(*_pack(texts), tables))
+    for t, flag in zip(texts, got):
+        if pattern.search(t):
+            assert flag, f"regex matches but kernel missed: {t!r}"
+
+
+def test_build_rejects_empty_or_oversized():
+    assert BadwordTables.build([], True) is None
+    assert BadwordTables.build(["ok", ""], True) is None
+    assert BadwordTables.build(["x" * 100], True) is None
+
+
+def test_vendored_list_loads_and_builds():
+    words = load_local_badwords("en")
+    assert words and len(words) > 50
+    assert BadwordTables.build(words, check_boundaries=True) is not None
+    assert load_local_badwords("xx") is None
+
+
+CONFIG = """
+pipeline:
+  - type: C4BadWordsFilter
+    default_language: en
+    keep_fraction: 0.0
+    fail_on_missing_language: true
+"""
+
+
+def _mk(i, text, metadata=None):
+    return TextDocument(
+        id=f"d{i}", source="t", content=text, metadata=dict(metadata or {})
+    )
+
+
+def test_device_parity_with_host_filter():
+    config = parse_pipeline_config(CONFIG)
+    texts = [
+        "a perfectly clean document about the weather today",
+        "this document mentions sex explicitly",
+        "classic assignment of passes",  # substrings only, no word match
+        "",
+    ]
+    docs_h = [_mk(i, t) for i, t in enumerate(texts)]
+    docs_d = [_mk(i, t) for i, t in enumerate(texts)]
+
+    executor = build_pipeline_from_config(config)
+    host = list(process_documents_host(executor, iter(docs_h)))
+    pipeline = CompiledPipeline(config, batch_size=8, buckets=(512,))
+    assert pipeline.device_steps and not pipeline.host_steps
+    dev = list(process_documents_device(config, iter(docs_d), pipeline=pipeline))
+
+    hmap = {o.document.id: o for o in host}
+    dmap = {o.document.id: o for o in dev}
+    assert set(hmap) == set(dmap)
+    for k in hmap:
+        assert hmap[k].kind == dmap[k].kind, k
+        assert hmap[k].reason == dmap[k].reason, k
+        assert (
+            hmap[k].document.metadata.get("c4_badwords_filter_status")
+            == dmap[k].document.metadata.get("c4_badwords_filter_status")
+        ), k
+
+
+def test_device_lang_mismatch_falls_back_to_host_step():
+    config = parse_pipeline_config(CONFIG)
+    # metadata language 'da' != compiled 'en' -> per-doc host filter run,
+    # which applies the Danish list.
+    danish_words = load_local_badwords("da")
+    assert danish_words
+    bad_da = danish_words[0]
+    docs = [
+        _mk(0, f"dette indeholder {bad_da} desvaerre", {"language": "da"}),
+        _mk(1, "helt ren tekst om vejret", {"language": "da"}),
+    ]
+    import os
+
+    cwd = os.getcwd()
+    os.chdir("/root/repo")  # vendored fallback path for the host filter
+    try:
+        dev = list(process_documents_device(config, iter(docs)))
+    finally:
+        os.chdir(cwd)
+    kinds = {o.document.id: o.kind for o in dev}
+    assert kinds["d0"] == ProcessingOutcome.FILTERED
+    assert kinds["d1"] == ProcessingOutcome.SUCCESS

@@ -30,7 +30,12 @@ from ..data_model import TextDocument
 from ..errors import DocumentFiltered
 from ..executor import ProcessingStep
 
-__all__ = ["C4BadWordsFilter", "C4BadWordsParams", "BADWORDS_LANGS"]
+__all__ = [
+    "C4BadWordsFilter",
+    "C4BadWordsParams",
+    "BADWORDS_LANGS",
+    "load_local_badwords",
+]
 
 _EN_BADWORDS_URL = (
     "https://raw.githubusercontent.com/LDNOOBW/List-of-Dirty-Naughty-Obscene-"
@@ -70,6 +75,29 @@ class _BadwordsError(Exception):
     def __init__(self, reason: str) -> None:
         super().__init__(reason)
         self.reason = reason
+
+
+def load_local_badwords(
+    lang: str, cache_base_path: Optional[Path] = None
+) -> Optional[list]:
+    """The language's word list from local sources only (cache dir, then the
+    vendored package data) — no network.  None if unavailable; [] if the list
+    exists but is empty.  Used by the device kernel builder
+    (:mod:`textblaster_tpu.ops.badwords`), which must not trigger downloads
+    at trace time."""
+    if lang not in BADWORDS_LANGS:
+        return None
+    cache_dir = (
+        Path(cache_base_path) if cache_base_path else Path("data") / "c4_badwords"
+    )
+    for candidate in (cache_dir / lang, _VENDORED_DIR / lang):
+        if candidate.exists():
+            try:
+                content = candidate.read_text(encoding="utf-8")
+            except OSError:
+                return None
+            return [w.strip() for w in content.splitlines() if w.strip()]
+    return None
 
 
 class C4BadWordsFilter(ProcessingStep):

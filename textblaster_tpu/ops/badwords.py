@@ -1,0 +1,118 @@
+"""C4 bad-words candidate detection on device.
+
+The reference scans every document with one big case-insensitive alternation
+regex per language (c4_filters.rs:431-447).  On device that scan becomes a
+**rolling-hash membership test**: one prefix polynomial hash over the
+lowercased row, then for each distinct pattern length an O(1) window-hash
+(prefix-difference) checked against the sorted hash table of that length's
+patterns, plus word-boundary masks for non-CJK languages
+(c4_filters.rs:433-439: CJK patterns get no ``\\W`` anchors).
+
+The kernel is *candidate-exact in the safe direction*: a true regex match is
+always flagged (the hash is computed from the same codepoints the pattern
+hash used; boundary classes mirror ``\\w`` via the shared char table), while
+hash collisions can only over-flag.  The host finalizer runs the real regex
+filter on flagged documents only — so final decisions equal the reference's,
+and the expensive scan is skipped for the (vast) majority of clean documents.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .device import ALNUM, classify, isin_sorted, lower_table
+from .stats import _first_col, _poly_hash, _shift_r
+
+__all__ = ["BadwordTables", "badwords_candidates", "MAX_PATTERN_CPS"]
+
+#: Patterns longer than this (in codepoints) disqualify device execution —
+#: real LDNOOBW entries are far shorter.
+MAX_PATTERN_CPS = 48
+
+
+def _hash_cps(cps: Sequence[int]) -> int:
+    """Host twin of the device window hash (int32 wraparound, mul 31)."""
+    h = 0
+    for c in cps:
+        h = (h * 31 + c) & 0xFFFFFFFF
+    return h - (1 << 32) if h >= (1 << 31) else h
+
+
+def _pow31(n: int) -> int:
+    p = pow(31, n, 1 << 32)
+    return p - (1 << 32) if p >= (1 << 31) else p
+
+
+class BadwordTables(NamedTuple):
+    """Per-length sorted hash tables for one language's pattern list."""
+
+    lengths: Tuple[int, ...]
+    tables: Tuple[np.ndarray, ...]  # sorted int32 hashes, one per length
+    check_boundaries: bool  # False for CJK languages (ja/th/zh)
+
+    @classmethod
+    def build(
+        cls, words: Sequence[str], check_boundaries: bool
+    ) -> Optional["BadwordTables"]:
+        """None if any pattern is empty/too long (caller falls back to host)."""
+        by_len: Dict[int, List[int]] = {}
+        for w in words:
+            cps = [ord(c) for c in w.lower()]
+            if not cps or len(cps) > MAX_PATTERN_CPS:
+                return None
+            by_len.setdefault(len(cps), []).append(_hash_cps(cps))
+        if not by_len:
+            return None
+        lengths = tuple(sorted(by_len))
+        tables = tuple(
+            np.unique(np.array(by_len[n], dtype=np.int32)) for n in lengths
+        )
+        return cls(lengths=lengths, tables=tables, check_boundaries=check_boundaries)
+
+
+def badwords_candidates(
+    cps: jax.Array, lengths: jax.Array, tables: BadwordTables
+) -> jax.Array:
+    """``[B] bool`` — document contains a window whose lowercased content
+    hash matches a pattern of that length (with boundary masks unless CJK)."""
+    _, length = cps.shape
+    pos = jnp.arange(length, dtype=jnp.int32)[None, :]
+    mask = pos < lengths[:, None]
+
+    lt = lower_table()
+    low = jnp.where(mask, lt[jnp.minimum(cps, lt.shape[0] - 1)], 0)
+
+    # Inclusive prefix hash over the whole row: h[i] = hash(low[0..=i]).
+    h = _poly_hash(low, mask, _first_col(mask))
+    h_prev = _shift_r(h, 0)  # hash(low[0..i)) at position i
+
+    if tables.check_boundaries:
+        # Regex \w ≈ alphanumeric or underscore (shared char table semantics).
+        wordch = ((classify(low) & ALNUM) != 0) | (low == ord("_"))
+        nonword_before = ~_shift_r(wordch, False)  # start-of-row => boundary
+        after_pad = jnp.pad(wordch[:, 1:], ((0, 0), (0, 1)))
+    else:
+        nonword_before = None
+        after_pad = None
+
+    match = jnp.zeros(cps.shape[0], dtype=bool)
+    for n, table in zip(tables.lengths, tables.tables):
+        if n > length:
+            continue
+        # Window [i, i+n): hash = h[i+n-1] - h[i-1] * 31^n  (int32 wrap).
+        h_end = jnp.pad(h[:, n - 1 :], ((0, 0), (0, n - 1)))
+        w = h_end - h_prev * jnp.int32(_pow31(n))
+        ok = (pos + n) <= lengths[:, None]
+        hit = isin_sorted(w, jnp.asarray(table)) & ok
+        if tables.check_boundaries:
+            # Char after the window: position i+n (row end => boundary).
+            after_word = jnp.pad(
+                after_pad[:, n - 1 :], ((0, 0), (0, n - 1))
+            ) & ((pos + n) < lengths[:, None])
+            hit = hit & nonword_before & ~after_word
+        match = match | jnp.any(hit, axis=1)
+    return match

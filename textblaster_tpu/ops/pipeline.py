@@ -39,6 +39,7 @@ from ..filters.fineweb_quality import DEFAULT_STOP_CHARS
 from ..models.langid import ISO_TO_NAME, NAME_TO_ISO, LangIdModel
 from ..orchestration import execute_processing_pipeline
 from ..pipeline_builder import build_pipeline_from_config
+from .badwords import badwords_candidates
 from .langid_tpu import langid_scores
 from .packing import DEFAULT_BUCKETS, PackedBatch, iter_packed_batches
 from .stats import (
@@ -61,17 +62,39 @@ _DEVICE_STEPS = {
     "GopherQualityFilter",
     "C4QualityFilter",
     "FineWebQualityFilter",
+    "C4BadWordsFilter",
 }
+
+_CJK_BADWORDS_LANGS = ("ja", "th", "zh")  # c4_filters.rs:70
 
 
 def device_step_types() -> frozenset:
     return frozenset(_DEVICE_STEPS)
 
 
+def _badwords_tables(step: StepConfig):
+    """BadwordTables for the step's default language from local lists only,
+    or None (-> host execution).  Cached per (lang, cache path)."""
+    from ..filters.c4_badwords import load_local_badwords
+    from .badwords import BadwordTables
+
+    p = step.params
+    words = load_local_badwords(p.default_language, p.cache_base_path)
+    if not words:
+        # Unavailable or empty: the host filter owns the semantics
+        # (download, passed_no_regex, fail_on_missing_language).
+        return None
+    return BadwordTables.build(
+        words, check_boundaries=p.default_language not in _CJK_BADWORDS_LANGS
+    )
+
+
 def _step_on_device(step: StepConfig) -> bool:
     if step.type not in _DEVICE_STEPS:
         return False
     if step.type == "C4QualityFilter" and not step.params.split_paragraph:
+        return False
+    if step.type == "C4BadWordsFilter" and _badwords_tables(step) is None:
         return False
     return True
 
@@ -129,6 +152,16 @@ class CompiledPipeline:
         self._host_suffix_executor = None
         self._jitted: Dict[int, Callable] = {}
         self._langid = LangIdModel()
+        self._badwords_steps: Dict[int, object] = {}
+
+    def _badwords_host_step(self, idx: int):
+        """The real host C4BadWordsFilter for device step ``idx`` — runs only
+        on kernel-flagged candidates (shared regex cache + RNG across docs)."""
+        if idx not in self._badwords_steps:
+            from ..pipeline_builder import build_step
+
+            self._badwords_steps[idx] = build_step(self.device_steps[idx])
+        return self._badwords_steps[idx]
 
     # --- host executors -----------------------------------------------------
 
@@ -201,6 +234,8 @@ class CompiledPipeline:
                     else tuple(sorted(DEFAULT_STOP_CHARS))
                 )
                 plans.append(("fineweb", i, stop_chars))
+            elif step.type == "C4BadWordsFilter":
+                plans.append(("badwords", i, _badwords_tables(step)))
 
         def fn(cps, lengths):
             out: Dict[str, jax.Array] = {}
@@ -238,6 +273,10 @@ class CompiledPipeline:
                 elif kind == "fineweb":
                     for k, v in fineweb_stats(get_structure(), arg, max_lines).items():
                         out[f"{i}:{k}"] = v
+                elif kind == "badwords":
+                    out[f"{i}:candidate"] = badwords_candidates(
+                        state["cps"], state["lengths"], arg
+                    )
             return out
 
         if self.mesh is not None:
@@ -520,6 +559,26 @@ class CompiledPipeline:
                 _Decision(True, stamps=[("c4_filter_status", "passed")], extra=extra),
                 False,
             )
+
+        if step.type == "C4BadWordsFilter":
+            # The device kernel only prefilters: candidate docs (and docs
+            # whose metadata selects a different language than the compiled
+            # tables) run the real host filter — identical final decisions,
+            # regex scan skipped for clean documents (c4_filters.rs:456-552).
+            doc_lang = doc.metadata.get("language", p.default_language)
+            if doc_lang == p.default_language and not bool(g("candidate")):
+                return (
+                    _Decision(True, stamps=[("c4_badwords_filter_status", "passed")]),
+                    False,
+                )
+            from ..errors import DocumentFiltered
+
+            host_step = self._badwords_host_step(idx)
+            try:
+                host_step.process(doc)  # stamps metadata itself
+            except DocumentFiltered as e:
+                return _Decision(False, e.reason), False
+            return _Decision(True), False
 
         if step.type == "FineWebQualityFilter":
             if bool(g("line_overflow")):
