@@ -153,3 +153,46 @@ def test_device_lang_mismatch_falls_back_to_host_step():
     kinds = {o.document.id: o.kind for o in dev}
     assert kinds["d0"] == ProcessingOutcome.FILTERED
     assert kinds["d1"] == ProcessingOutcome.SUCCESS
+
+
+def test_keep_fraction_agrees_across_backends_and_order():
+    # Per-doc seeded draws: decisions are a pure function of (seed, doc.id),
+    # so host and device paths agree even though the device path consults the
+    # host filter only for kernel-flagged candidates, in batch order.
+    yaml_cfg = """
+pipeline:
+  - type: C4BadWordsFilter
+    default_language: en
+    keep_fraction: 0.5
+    seed: 42
+    fail_on_missing_language: true
+"""
+    config = parse_pipeline_config(yaml_cfg)
+    dirty = [f"document {i} mentions sex explicitly here" for i in range(24)]
+    clean = [f"a perfectly clean document number {i} about weather" for i in range(8)]
+    texts = [t for pair in zip(dirty[:8], clean) for t in pair] + dirty[8:]
+
+    docs_h = [_mk(i, t) for i, t in enumerate(texts)]
+    docs_r = [_mk(i, t) for i, t in enumerate(texts)][::-1]  # reversed order
+    docs_d = [_mk(i, t) for i, t in enumerate(texts)]
+
+    host = list(
+        process_documents_host(
+            build_pipeline_from_config(config), iter(docs_h)
+        )
+    )
+    host_rev = list(
+        process_documents_host(
+            build_pipeline_from_config(config), iter(docs_r)
+        )
+    )
+    pipeline = CompiledPipeline(config, batch_size=8, buckets=(512,))
+    dev = list(process_documents_device(config, iter(docs_d), pipeline=pipeline))
+
+    hmap = {o.document.id: o.kind for o in host}
+    rmap = {o.document.id: o.kind for o in host_rev}
+    dmap = {o.document.id: o.kind for o in dev}
+    assert hmap == rmap  # order-independent
+    assert hmap == dmap  # backend-independent
+    kinds = [hmap[f"d{i}"] for i, t in enumerate(texts) if "sex" in t]
+    assert len(set(kinds)) == 2  # keep_fraction actually kept and dropped some

@@ -175,3 +175,43 @@ def test_device_backend_checkpointed(tmp_path, config):
         pq.read_table(out).to_pydict()["id"]
         == pq.read_table(plain_out).to_pydict()["id"]
     )
+
+
+def test_refuses_foreign_non_empty_directory(tmp_path, config):
+    # A non-empty dir without a cursor is not ours; finalization must never
+    # delete unrelated user files (e.g. --checkpoint-dir .).
+    inp = str(tmp_path / "in.parquet")
+    _write_input(inp, n=10)
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    (ckpt / "precious.txt").write_text("do not delete")
+    with pytest.raises(CheckpointError, match="not empty"):
+        run_checkpointed(
+            config, inp, str(tmp_path / "o.parquet"), str(tmp_path / "e.parquet"),
+            ckpt_dir=str(ckpt), chunk_size=5, backend="host",
+        )
+    assert (ckpt / "precious.txt").read_text() == "do not delete"
+
+
+def test_finalize_preserves_unrelated_files(tmp_path, config):
+    # Files that appear in the checkpoint dir mid-run (ours or not) survive
+    # finalization; only the cursor and recorded parts are removed.
+    inp = str(tmp_path / "in.parquet")
+    _write_input(inp, n=30)
+    ckpt = tmp_path / "ckpt"
+    out = str(tmp_path / "out.parquet")
+    excl = str(tmp_path / "excl.parquet")
+    with pytest.raises(CheckpointError, match="fault injection"):
+        run_checkpointed(
+            config, inp, out, excl, ckpt_dir=str(ckpt), chunk_size=10,
+            backend="host", stop_after_chunks=1,
+        )
+    (ckpt / "stray.log").write_text("user data")
+    result = run_checkpointed(
+        config, inp, out, excl, ckpt_dir=str(ckpt), chunk_size=10, backend="host",
+    )
+    assert result.received == 30
+    assert os.path.exists(out)
+    assert (ckpt / "stray.log").read_text() == "user data"
+    assert not os.path.exists(ckpt / CHECKPOINT_FILE)
+    assert not any(p.suffix == ".parquet" for p in ckpt.iterdir())

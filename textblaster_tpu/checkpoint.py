@@ -30,7 +30,6 @@ import hashlib
 import json
 import logging
 import os
-import shutil
 from dataclasses import dataclass, field
 from itertools import islice
 from typing import Callable, Iterator, List, Optional
@@ -163,6 +162,13 @@ class _PartWriter:
             # resume reprocesses the whole chunk.
 
 
+def _unlink_quiet(path: str) -> None:
+    try:
+        os.unlink(path)
+    except FileNotFoundError:
+        pass
+
+
 def _concat_parts(ckpt_dir: str, parts: List[str], out_path: str) -> None:
     parent = os.path.dirname(out_path)
     if parent:
@@ -204,6 +210,14 @@ def run_checkpointed(
     config_hash = _config_fingerprint(config)
 
     state = CheckpointState.load(ckpt_dir)
+    if state is None and os.listdir(ckpt_dir):
+        # A non-empty directory without a cursor is not ours: finalization
+        # deletes the subsystem's artifacts, and starting a run inside e.g.
+        # `--checkpoint-dir .` must never end with user files removed.
+        raise CheckpointError(
+            f"checkpoint directory '{ckpt_dir}' is not empty and contains no "
+            f"{CHECKPOINT_FILE}; use an empty (or new) directory"
+        )
     if state is not None:
         if state.input != fingerprint:
             raise CheckpointError(
@@ -326,10 +340,21 @@ def run_checkpointed(
         excl_parts.abort()
         raise
 
-    # Finalize: single kept/excluded pair with the reference's schema.
+    # Finalize: single kept/excluded pair with the reference's schema.  Only
+    # artifacts this subsystem created are deleted — the directory itself is
+    # removed only if that leaves it empty (it may pre-exist, e.g. ".").
     _concat_parts(ckpt_dir, state.out_parts, output_file)
     _concat_parts(ckpt_dir, state.excl_parts, excluded_file)
-    shutil.rmtree(ckpt_dir)
+    for name in state.out_parts + state.excl_parts:
+        _unlink_quiet(os.path.join(ckpt_dir, name))
+    _unlink_quiet(os.path.join(ckpt_dir, CHECKPOINT_FILE))
+    _unlink_quiet(os.path.join(ckpt_dir, CHECKPOINT_FILE + ".tmp"))
+    try:
+        os.rmdir(ckpt_dir)
+    except OSError:
+        logger.warning(
+            "checkpoint directory '%s' not removed (not empty)", ckpt_dir
+        )
 
     result.read_errors = read_errors_box[0]
     return result

@@ -6,10 +6,17 @@ language-keyed LDNOOBW blocklists with an on-disk cache, lazily compiled into
 one case-insensitive alternation regex per language (CJK languages without
 word-boundary anchors — c4_filters.rs:431-439), and a seeded keep-fraction.
 
-RNG parity note: the reference draws ``f32`` from Rust's ``StdRng`` (ChaCha12,
-c4_filters.rs:306-309).  That exact stream is not reproducible here, so the
-keep-fraction is *distributionally* equivalent (seeded ``random.Random``) —
-the renegotiation SURVEY.md §7 anticipates.
+RNG parity note: the reference draws ``f32`` from a *shared* Rust ``StdRng``
+stream (ChaCha12, c4_filters.rs:306-309), which makes its keep decisions
+depend on the order documents happen to reach the worker — nondeterministic
+under queue delivery.  This build renegotiates to something strictly
+stronger: with ``seed`` set, each document draws from
+``sha256(seed, doc.id)``, so the decision is a pure function of the document
+— identical across host/device backends, batch orderings, and resumed runs
+(the distributional property, uniform keep at ``keep_fraction``, is
+preserved; the renegotiation SURVEY.md §7 anticipates).  With ``seed`` unset
+the draw falls back to an unseeded shared stream, nondeterministic like the
+reference's default.
 
 Network note: the reference downloads lists over HTTP at first use
 (c4_filters.rs:354-412).  This build ships vendored LDNOOBW lists for the
@@ -19,6 +26,7 @@ back to HTTP when a list is neither vendored nor cached.
 
 from __future__ import annotations
 
+import hashlib
 import os
 import random
 import re
@@ -188,6 +196,19 @@ class C4BadWordsFilter(ProcessingStep):
             raise _BadwordsError(f"I/O error: {e}") from e
         return content
 
+    def _keep_draw(self, doc_id: str) -> float:
+        """Uniform [0,1) draw deciding keep-by-fraction for one document.
+
+        Seeded runs hash (seed, doc id) so the decision is order-independent —
+        a pure host run, the device-prefiltered path, and a checkpoint resume
+        all agree (see the module docstring's RNG parity note)."""
+        if self.params.seed is None:
+            return self._rng.random()
+        h = hashlib.sha256(
+            f"{self.params.seed}:{doc_id}".encode("utf-8")
+        ).digest()
+        return int.from_bytes(h[:8], "big") / 2.0**64
+
     def process(self, document: TextDocument) -> TextDocument:
         lang = document.metadata.get("language", self.params.default_language)
 
@@ -203,7 +224,7 @@ class C4BadWordsFilter(ProcessingStep):
             return document
 
         if badwords_re.search(document.content):
-            if self.params.keep_fraction > 0.0 and self._rng.random() < self.params.keep_fraction:
+            if self.params.keep_fraction > 0.0 and self._keep_draw(document.id) < self.params.keep_fraction:
                 document.metadata["c4_badwords_filter_status"] = "passed_kept_by_fraction"
                 return document
             reason = "document_removed_with_badwords"

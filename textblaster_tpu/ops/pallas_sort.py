@@ -25,9 +25,11 @@ lowering probe fails, so CPU tests and degraded environments keep working.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import logging
 import os
+import threading
 from typing import Tuple
 
 import jax
@@ -41,9 +43,27 @@ except ImportError:  # pragma: no cover
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["sort3", "pallas_sort3", "pallas_sort_supported"]
+__all__ = ["sort3", "pallas_sort3", "pallas_sort_supported", "pallas_allowed"]
 
 _ROWS = 8  # sublane tile for int32
+
+_tls = threading.local()
+
+
+@contextlib.contextmanager
+def pallas_allowed(allowed: bool):
+    """Scope the Pallas fast path (default allowed).
+
+    Mosaic ``pallas_call`` custom calls carry no GSPMD partitioning rule, so a
+    program jitted with multi-device ``in_shardings`` must not contain them —
+    the compiled pipeline traces its stages under ``pallas_allowed(False)``
+    whenever it targets a >1-device mesh, falling back to ``lax.sort``."""
+    prev = getattr(_tls, "allowed", True)
+    _tls.allowed = allowed and prev
+    try:
+        yield
+    finally:
+        _tls.allowed = prev
 
 
 def _lex_gt(a: Tuple[jax.Array, ...], b: Tuple[jax.Array, ...]) -> jax.Array:
@@ -54,9 +74,11 @@ def _lex_gt(a: Tuple[jax.Array, ...], b: Tuple[jax.Array, ...]) -> jax.Array:
     return gt
 
 
-def _bitonic_kernel(k1_ref, k2_ref, k3_ref, o1_ref, o2_ref, o3_ref):
-    m = k1_ref.shape[-1]
-    ks = (k1_ref[:], k2_ref[:], k3_ref[:])
+def _bitonic_kernel(*refs):
+    n = len(refs) // 2
+    in_refs, out_refs = refs[:n], refs[n:]
+    m = in_refs[0].shape[-1]
+    ks = tuple(r[:] for r in in_refs)
 
     # In-kernel lane index (Pallas kernels cannot capture host constants).
     lane = jax.lax.broadcasted_iota(jnp.int32, (1, m), 1)
@@ -87,16 +109,14 @@ def _bitonic_kernel(k1_ref, k2_ref, k3_ref, o1_ref, o2_ref, o3_ref):
             stride //= 2
         size *= 2
 
-    o1_ref[:], o2_ref[:], o3_ref[:] = ks
+    for o, k in zip(out_refs, ks):
+        o[:] = k
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def pallas_sort3(
-    k1: jax.Array, k2: jax.Array, k3: jax.Array, interpret: bool = False
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Row-wise ascending lexicographic sort of ``(k1, k2, k3)`` (int32
-    ``[B, m]``, ``m`` a power of two, ``B`` a multiple of 8)."""
-    b, m = k1.shape
+def _pallas_sort_n(ks: Tuple[jax.Array, ...], interpret: bool = False):
+    """Row-wise ascending lexicographic sort of int32 ``[B, m]`` key arrays
+    (``m`` a power of two, ``B`` a multiple of 8)."""
+    b, m = ks[0].shape
     if m & (m - 1):
         raise ValueError(f"row length {m} is not a power of two")
     if b % _ROWS:
@@ -106,11 +126,25 @@ def pallas_sort3(
     return pl.pallas_call(
         _bitonic_kernel,
         grid=(b // _ROWS,),
-        in_specs=[spec, spec, spec],
-        out_specs=[spec, spec, spec],
-        out_shape=[shape, shape, shape],
+        in_specs=[spec] * len(ks),
+        out_specs=[spec] * len(ks),
+        out_shape=[shape] * len(ks),
         interpret=interpret,
-    )(k1.astype(jnp.int32), k2.astype(jnp.int32), k3.astype(jnp.int32))
+    )(*(k.astype(jnp.int32) for k in ks))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def pallas_sort3(
+    k1: jax.Array, k2: jax.Array, k3: jax.Array, interpret: bool = False
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    return tuple(_pallas_sort_n((k1, k2, k3), interpret=interpret))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def pallas_sort2(
+    k1: jax.Array, k2: jax.Array, interpret: bool = False
+) -> Tuple[jax.Array, jax.Array]:
+    return tuple(_pallas_sort_n((k1, k2), interpret=interpret))
 
 
 @functools.lru_cache(maxsize=1)
@@ -129,21 +163,46 @@ def pallas_sort_supported() -> bool:
         return False
 
 
+def _pallas_ok(b: int, m: int) -> bool:
+    return (
+        getattr(_tls, "allowed", True)
+        and pallas_sort_supported()
+        and m >= 128
+        and not (m & (m - 1))
+        and b % _ROWS == 0
+    )
+
+
 def sort3(
     k1: jax.Array, k2: jax.Array, k3: jax.Array
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Lexicographic row sort: Pallas bitonic network on TPU, ``lax.sort``
     elsewhere."""
     b, m = k1.shape
-    if (
-        pallas_sort_supported()
-        and m >= 128
-        and not (m & (m - 1))
-        and b % _ROWS == 0
-    ):
+    if _pallas_ok(b, m):
         return pallas_sort3(k1, k2, k3)
     return jax.lax.sort(
         (k1.astype(jnp.int32), k2.astype(jnp.int32), k3.astype(jnp.int32)),
         dimension=1,
         num_keys=3,
+    )
+
+
+def sort2(k1: jax.Array, k2: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Row sort by key ``k1`` carrying ``k2``, deterministic within equal
+    keys: ascending ``k2`` order.
+
+    Off-TPU this is the 1-key *stable* ``lax.sort`` (callers pass ``k2``
+    either already ascending per row — an iota — or as a payload whose
+    within-run order is irrelevant); on TPU it is the VMEM bitonic network
+    sorting the full ``(k1, k2)`` pair, which is equivalent up to within-run
+    payload order (and exactly equal for iota payloads)."""
+    b, m = k1.shape
+    if _pallas_ok(b, m):
+        return pallas_sort2(k1, k2)
+    return jax.lax.sort(
+        (k1.astype(jnp.int32), k2.astype(jnp.int32)),
+        dimension=1,
+        num_keys=1,
+        is_stable=True,
     )

@@ -23,7 +23,7 @@ the host oracle — the outlier path SURVEY.md §5 calls for.
 from __future__ import annotations
 
 import logging
-from functools import partial
+from functools import lru_cache, partial
 from typing import Callable, Dict, Iterable, Iterator, List, Optional, Tuple, Union
 
 import jax
@@ -39,6 +39,7 @@ from ..filters.fineweb_quality import DEFAULT_STOP_CHARS
 from ..models.langid import ISO_TO_NAME, NAME_TO_ISO, LangIdModel
 from ..orchestration import execute_processing_pipeline
 from ..pipeline_builder import build_pipeline_from_config
+from ..utils.metrics import METRICS
 from .badwords import badwords_candidates
 from .langid_tpu import langid_scores
 from .packing import DEFAULT_BUCKETS, PackedBatch, iter_packed_batches
@@ -72,21 +73,28 @@ def device_step_types() -> frozenset:
     return frozenset(_DEVICE_STEPS)
 
 
-def _badwords_tables(step: StepConfig):
-    """BadwordTables for the step's default language from local lists only,
-    or None (-> host execution).  Cached per (lang, cache path)."""
+@lru_cache(maxsize=64)
+def _badwords_tables_cached(default_language: str, cache_base_path):
     from ..filters.c4_badwords import load_local_badwords
     from .badwords import BadwordTables
 
-    p = step.params
-    words = load_local_badwords(p.default_language, p.cache_base_path)
+    words = load_local_badwords(default_language, cache_base_path)
     if not words:
         # Unavailable or empty: the host filter owns the semantics
         # (download, passed_no_regex, fail_on_missing_language).
         return None
     return BadwordTables.build(
-        words, check_boundaries=p.default_language not in _CJK_BADWORDS_LANGS
+        words, check_boundaries=default_language not in _CJK_BADWORDS_LANGS
     )
+
+
+def _badwords_tables(step: StepConfig):
+    """BadwordTables for the step's default language from local lists only,
+    or None (-> host execution).  Cached per (lang, cache path); the cache
+    also makes the `_step_on_device` check and `_build_fn` see one consistent
+    value even if the on-disk list disappears between them."""
+    p = step.params
+    return _badwords_tables_cached(p.default_language, p.cache_base_path)
 
 
 def _step_on_device(step: StepConfig) -> bool:
@@ -237,7 +245,13 @@ class CompiledPipeline:
             elif step.type == "C4BadWordsFilter":
                 plans.append(("badwords", i, _badwords_tables(step)))
 
+        # Mosaic pallas_call has no GSPMD partitioning rule: under a
+        # multi-device mesh every stage must trace the lax.sort fallback.
+        single_device = self.mesh is None or self.mesh.devices.size == 1
+
         def fn(cps, lengths):
+            from .pallas_sort import pallas_allowed
+
             out: Dict[str, jax.Array] = {}
             state = {"cps": cps, "lengths": lengths, "st": None}
 
@@ -246,6 +260,10 @@ class CompiledPipeline:
                     state["st"] = structure(state["cps"], state["lengths"])
                 return state["st"]
 
+            with pallas_allowed(single_device):
+                return _eval_plans(plans, state, out, get_structure, max_lines, max_words)
+
+        def _eval_plans(plans, state, out, get_structure, max_lines, max_words):
             for kind, i, arg in plans:
                 if kind == "langid":
                     scores, n_grams = langid_scores(state["cps"], state["lengths"])
@@ -563,8 +581,12 @@ class CompiledPipeline:
         if step.type == "C4BadWordsFilter":
             # The device kernel only prefilters: candidate docs (and docs
             # whose metadata selects a different language than the compiled
-            # tables) run the real host filter — identical final decisions,
-            # regex scan skipped for clean documents (c4_filters.rs:456-552).
+            # tables) run the real host filter — the regex scan is skipped for
+            # clean documents (c4_filters.rs:456-552).  Final decisions match
+            # a pure host run: the regex decides matches, and seeded
+            # keep-fraction draws are per-document (hash of seed + doc id),
+            # independent of which docs reached the host step or in what
+            # order (filters/c4_badwords.py RNG parity note).
             doc_lang = doc.metadata.get("language", p.default_language)
             if doc_lang == p.default_language and not bool(g("candidate")):
                 return (
@@ -678,10 +700,23 @@ class CompiledPipeline:
         """Blocking half: transfer stats, resolve order/short-circuit/reason
         strings per document."""
         stats = {k: np.asarray(v) for k, v in device_stats.items()}
+        # Rows where any step hit a kernel table bound rerun the host oracle
+        # on the PRISTINE document (no device-side stamps/rewrites applied
+        # yet), so fallback outcomes are bit-identical to a pure host run.
+        n_rows = len(batch.docs)
+        overflow_any = np.zeros(n_rows, dtype=bool)
+        for key, v in stats.items():
+            if key.endswith(("seg_overflow", "word_overflow", "line_overflow")):
+                overflow_any |= np.asarray(v[:n_rows], dtype=bool)
         outcomes: List[ProcessingOutcome] = []
         for row, doc in enumerate(batch.docs):
-            outcome = self._assemble(stats, row, doc)
-            outcomes.append(outcome)
+            if overflow_any[row]:
+                METRICS.inc("worker_host_fallback_total")
+                outcome = execute_processing_pipeline(self.host_executor, doc)
+            else:
+                outcome = self._assemble(stats, row, doc)
+            if outcome is not None:  # hard error -> no outcome (reference quirk)
+                outcomes.append(outcome)
         return outcomes
 
     def process_batch(self, batch: PackedBatch) -> List[ProcessingOutcome]:
@@ -718,6 +753,14 @@ def process_documents_device(
 ) -> Iterator[ProcessingOutcome]:
     """Device-backed processing loop: packs the stream into bucketed batches,
     runs the compiled pipeline, assembles outcomes in input order per batch.
+
+    Outcome **ordering** is deterministic but not input order: documents are
+    grouped by length bucket, one batch is kept in flight (assembly of batch
+    k overlaps device compute of batch k+1), and host-fallback outliers are
+    yielded when encountered, so outcomes interleave across batches.  Output
+    row order is NOT contractual — the reference has none either (its results
+    queue returns worker-completion order, producer_logic.rs:141-176); tests
+    compare outputs as id-keyed sets.
 
     Pass a prebuilt ``pipeline`` to reuse its compiled programs across
     multiple streams (the checkpointed runner processes one chunk per call)."""
@@ -761,6 +804,7 @@ def process_documents_device(
                 yield from pipeline.assemble_batch(*pending)
             pending = (batch, stats)
         for doc in fallback:
+            METRICS.inc("worker_host_fallback_total")
             outcome = execute_processing_pipeline(pipeline.host_executor, doc)
             if outcome is not None:
                 yield outcome

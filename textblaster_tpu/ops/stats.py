@@ -52,7 +52,7 @@ from .device import (
     word_mask,
 )
 from .dfa import citation_spans, dfa_states
-from .pallas_sort import sort3
+from .pallas_sort import sort2
 
 __all__ = [
     "TextStructure",
@@ -279,21 +279,61 @@ def _last_nonws_in_line(nonws: jax.Array, li: LineInfo, mask: jax.Array) -> jax.
 # Invalid slots carry a leading 1 key, sorting them past all real segments.
 
 
-def _sort_triple(seg_hash, second, seg_valid):
-    invalid = (~seg_valid).astype(jnp.int32)
-    s_invalid, s_hash, s_second = sort3(invalid, seg_hash, second)
-    return s_invalid == 0, s_hash, s_second
+_I32_MAX = np.int32(2**31 - 1)
 
 
-def _dup_counts(seg_hash, seg_bytes, seg_valid) -> Tuple[jax.Array, jax.Array]:
+def _sort_runs_many(jobs):
+    """Sort many same-shaped ``(hash, payload, valid)`` jobs in ONE device
+    sort, returning ``(is_real, s_hash, s_payload)`` per job.
+
+    Two structural tricks keep this cheap (it was the pipeline's dominant
+    cost when emitted as one 3-key sort per n-gram size):
+
+    * jobs stack along the batch axis — rows are independent, so k jobs of
+      shape ``[B, m]`` cost one ``[kB, m]`` sort network / lax.sort call;
+    * the sort uses a SINGLE int32 key: invalid slots are biased to
+      ``INT32_MAX`` and valid hashes clamped to ``INT32_MAX - 1`` (one more
+      2^-32-per-pair collision class on top of hashing itself, see module
+      docstring), so validity needs no second key and ``is_real`` is just a
+      position-vs-count compare after the sort.  Runs are keyed by hash
+      alone; the payload rides as a sort value (stable off-TPU, full-pair
+      bitonic on TPU — within-run payload order differs, which no consumer
+      depends on for iota/byte payloads under the no-collision assumption).
+    """
+    b, m = jobs[0][0].shape
+    keys, n_valid = [], []
+    for h, _, v in jobs:
+        keys.append(jnp.where(v, jnp.minimum(h, _I32_MAX - 1), _I32_MAX))
+        n_valid.append(jnp.sum(v, axis=1).astype(jnp.int32))
+    if len(jobs) == 1:
+        s_key, s_payload = sort2(keys[0], jobs[0][1])
+    else:
+        s_key, s_payload = sort2(
+            jnp.concatenate(keys, axis=0),
+            jnp.concatenate([j[1] for j in jobs], axis=0),
+        )
+    iota = jnp.arange(m, dtype=jnp.int32)[None, :]
+    outs = []
+    for i, nv in enumerate(n_valid):
+        outs.append(
+            (
+                iota < nv[:, None],
+                s_key[i * b : (i + 1) * b],
+                s_payload[i * b : (i + 1) * b],
+            )
+        )
+    return outs
+
+
+def _dup_counts_sorted(sorted_triple) -> Tuple[jax.Array, jax.Array]:
     """find_duplicates semantics over hashed segments: every occurrence after
     the first counts (text.rs:197-208)."""
-    is_real, s_hash, s_bytes = _sort_triple(seg_hash, seg_bytes, seg_valid)
+    is_real, s_hash, s_bytes = sorted_triple
     same_prev = (
         jnp.concatenate(
             [
                 jnp.zeros_like(is_real[:, :1]),
-                (s_hash[:, 1:] == s_hash[:, :-1]) & (s_bytes[:, 1:] == s_bytes[:, :-1]),
+                s_hash[:, 1:] == s_hash[:, :-1],
             ],
             axis=1,
         )
@@ -304,14 +344,18 @@ def _dup_counts(seg_hash, seg_bytes, seg_valid) -> Tuple[jax.Array, jax.Array]:
     return dup_elems, dup_bytes
 
 
-def _top_duplicate(seg_hash, seg_bytes, seg_valid) -> jax.Array:
+def _dup_counts(seg_hash, seg_bytes, seg_valid) -> Tuple[jax.Array, jax.Array]:
+    return _dup_counts_sorted(_sort_runs_many([(seg_hash, seg_bytes, seg_valid)])[0])
+
+
+def _top_duplicate_sorted(sorted_triple) -> jax.Array:
     """find_top_duplicate semantics: bytes*count of the most frequent item,
     ties by larger contribution, 0 when nothing repeats (text.rs:211-238)."""
-    is_real, s_hash, s_bytes = _sort_triple(seg_hash, seg_bytes, seg_valid)
+    is_real, s_hash, s_bytes = sorted_triple
     run_start = jnp.concatenate(
         [
             jnp.ones_like(is_real[:, :1]),
-            (s_hash[:, 1:] != s_hash[:, :-1]) | (s_bytes[:, 1:] != s_bytes[:, :-1]),
+            s_hash[:, 1:] != s_hash[:, :-1],
         ],
         axis=1,
     )
@@ -495,8 +539,9 @@ def gopher_rep_stats(
 
     lh, lb, lv, n_l = seg_table(l_content, l_start)
     ph, pb, pv, n_p = seg_table(p_content, p_start)
-    l_dup_elems, l_dup_bytes = _dup_counts(lh, lb, lv)
-    p_dup_elems, p_dup_bytes = _dup_counts(ph, pb, pv)
+    l_sorted, p_sorted = _sort_runs_many([(lh, lb, lv), (ph, pb, pv)])
+    l_dup_elems, l_dup_bytes = _dup_counts_sorted(l_sorted)
+    p_dup_elems, p_dup_bytes = _dup_counts_sorted(p_sorted)
 
     # Word tables for n-grams.
     valid_end = st.unit_end & st.unit_valid
@@ -517,35 +562,50 @@ def gopher_rep_stats(
         "word_overflow": n_words > max_words,
     }
 
-    for n in sorted(set(list(top_ns) + list(dup_ns))):
+    # Build all n-gram tables, then run every dup-detection sort as ONE
+    # batched device sort and every greedy-selection DFA as ONE batched scan
+    # (per-n emission dominated compile time and HLO size).
+    ns = sorted(set(list(top_ns) + list(dup_ns)))
+    grams = {}
+    for n in ns:
         gh = jnp.zeros_like(whash)
         gb = jnp.zeros_like(wbytes)
         for k in range(n):
             gh = gh * jnp.int32(1000003) + jnp.pad(whash[:, k:], ((0, 0), (0, k)))
             gb = gb + jnp.pad(wbytes[:, k:], ((0, 0), (0, k)))
         win_valid = (widx + n) <= n_words[:, None]
+        grams[n] = (gh, gb, win_valid)
+
+    b, m = whash.shape
+    idx = jnp.broadcast_to(jnp.arange(m, dtype=jnp.int32)[None, :], (b, m))
+    jobs, tags = [], []
+    for n in ns:
+        gh, gb, win_valid = grams[n]
         if n in top_ns:
             # " "-joined n-grams: byte length includes n-1 single-byte spaces.
-            out[f"top_{n}"] = _top_duplicate(gh, gb + (n - 1), win_valid)
+            jobs.append((gh, gb + (n - 1), win_valid))
+            tags.append(("top", n))
         if n in dup_ns:
-            out[f"dup_{n}"] = _greedy_dup_bytes(gh, gb, win_valid, n)
+            jobs.append((gh, idx, win_valid))
+            tags.append(("dup", n))
+
+    greedy_jobs = []
+    for (kind, n), st in zip(tags, _sort_runs_many(jobs)):
+        if kind == "top":
+            out[f"top_{n}"] = _top_duplicate_sorted(st)
+        else:
+            gh, gb, win_valid = grams[n]
+            dup = _dup_flags_sorted(st, win_valid, idx)
+            greedy_jobs.append((n, dup, gb))
+    out.update(_greedy_dup_bytes_batched(greedy_jobs))
     return out
 
 
-def _greedy_dup_bytes(gh, gb, win_valid, n: int) -> jax.Array:
-    """find_all_duplicate: non-overlapping greedy scan, advancing n on a hit
-    (text.rs:241-259); see module docstring for the visited-set approximation.
-
-    The greedy left-to-right selection (a hit at window ``i`` blocks windows
-    ``i+1..i+n-1``) is an ``n``-state machine over the per-window dup flags:
-    state = positions still blocked.  Evaluated as a log-depth associative
-    composition of the per-position state maps (:func:`.dfa.dfa_states`)
-    rather than a length-``m`` sequential ``lax.scan`` — the scan dominated
-    both compile and run time on TPU at ``m`` up to 16384.
-    """
-    b, m = gh.shape
-    idx = jnp.broadcast_to(jnp.arange(m, dtype=jnp.int32)[None, :], (b, m))
-    is_real, s_hash, sidx = _sort_triple(gh, idx, win_valid)
+def _dup_flags_sorted(sorted_triple, win_valid, idx) -> jax.Array:
+    """Per-window "an earlier identical window exists" flags from a
+    ``(hash, idx)``-sorted table (find_all_duplicate's dup test)."""
+    is_real, s_hash, sidx = sorted_triple
+    b, m = s_hash.shape
     run_start = jnp.concatenate(
         [
             jnp.ones((b, 1), dtype=bool),
@@ -556,21 +616,54 @@ def _greedy_dup_bytes(gh, gb, win_valid, n: int) -> jax.Array:
     # Sorted by (hash, idx): the run's first slot holds the minimum index.
     first_in_run = seg_scan_max(jnp.where(run_start, sidx, -(2**30)), run_start)
     first_occ = _scatter(first_in_run, sidx, is_real, m)
+    return win_valid & (first_occ < idx)
 
-    dup = win_valid & (first_occ < idx)
-    if n <= 1:
-        selected = dup
-    else:
+
+def _greedy_dup_bytes_batched(jobs) -> Dict[str, jax.Array]:
+    """find_all_duplicate: non-overlapping greedy scan, advancing n on a hit
+    (text.rs:241-259); see module docstring for the visited-set approximation.
+
+    The greedy left-to-right selection (a hit at window ``i`` blocks windows
+    ``i+1..i+n-1``) is an ``n``-state machine over the per-window dup flags:
+    state = positions still blocked.  All n-gram sizes are evaluated in one
+    log-depth associative composition of per-position state maps (padded to
+    the largest state count and stacked along the batch axis) rather than a
+    length-``m`` sequential ``lax.scan`` — the scan dominated both compile
+    and run time on TPU at ``m`` up to 16384.
+    """
+    out: Dict[str, jax.Array] = {}
+    direct = [(n, dup, gb) for n, dup, gb in jobs if n <= 1]
+    dfa = [(n, dup, gb) for n, dup, gb in jobs if n > 1]
+    for n, dup, gb in direct:
+        out[f"dup_{n}"] = jnp.sum(jnp.where(dup, gb, 0), axis=1).astype(jnp.int32)
+    if not dfa:
+        return out
+
+    n_states = max(n for n, _, _ in dfa)
+    fns = []
+    for n, dup, _ in dfa:
         # States 0..n-1; 0 = free.  Symbol 1 (dup) at a free position selects
         # the window and blocks the next n-1; any symbol decrements a block.
-        t = np.zeros((2, n), dtype=np.int32)
+        # States >= n are unreachable padding (mapped to 0).
+        t = np.zeros((2, n_states), dtype=np.int32)
         for s in range(1, n):
             t[0, s] = s - 1
             t[1, s] = s - 1
         t[1, 0] = n - 1
-        state = dfa_states(dup.astype(jnp.int32), t)
+        fns.append(jnp.asarray(t, dtype=jnp.int32)[dup.astype(jnp.int32)])
+
+    stacked = jnp.concatenate(fns, axis=0)  # [kB, m, n_states]
+
+    def compose(a, b_):
+        return jnp.take_along_axis(b_, a, axis=-1)
+
+    states = jax.lax.associative_scan(compose, stacked, axis=1)[..., 0]
+    b = dfa[0][1].shape[0]
+    for i, (n, dup, gb) in enumerate(dfa):
+        state = states[i * b : (i + 1) * b]
         selected = dup & (_shift_r(state, 0) == 0)
-    return jnp.sum(jnp.where(selected, gb, 0), axis=1).astype(jnp.int32)
+        out[f"dup_{n}"] = jnp.sum(jnp.where(selected, gb, 0), axis=1).astype(jnp.int32)
+    return out
 
 
 # --- Sentence counting (device twin of split_into_sentences) -----------------

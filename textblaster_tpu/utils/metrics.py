@@ -56,6 +56,11 @@ _SPECS: Dict[str, Tuple[str, str]] = {
         "Per-document processing duration",
     ),
     "worker_active_tasks": ("gauge", "Documents currently being processed"),
+    "worker_host_fallback_total": (
+        "counter",
+        "Documents rerouted to the host oracle (kernel table overflow or "
+        "over-length outliers)",
+    ),
 }
 
 
