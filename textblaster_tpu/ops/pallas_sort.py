@@ -102,8 +102,11 @@ def _bitonic_kernel(*refs):
             )
             lower = tuple(jnp.where(is_lower, k, p) for k, p in zip(ks, partners))
             upper = tuple(jnp.where(is_lower, p, k) for k, p in zip(ks, partners))
-            swap = jnp.where(
-                asc, _lex_gt(lower, upper), _lex_gt(upper, lower)
+            # Select between the two bool comparisons with i1 bitwise logic:
+            # Mosaic cannot lower `select_n` with bool *operands* at >1 lane
+            # tile (arith.trunci vector<i8> -> vector<i1> is unsupported).
+            swap = (asc & _lex_gt(lower, upper)) | (
+                jnp.logical_not(asc) & _lex_gt(upper, lower)
             )
             ks = tuple(jnp.where(swap, p, k) for k, p in zip(ks, partners))
             stride //= 2
