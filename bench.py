@@ -39,6 +39,31 @@ N_DOCS = 4096
 CPU_SAMPLE = 384  # oracle subsample, extrapolated
 SEED = 20260729
 
+# Device batch rows.  Large batches amortize the remote tunnel's per-dispatch
+# round trip (~66ms) and upload latency (~65 MB/s measured); 1024 rows of the
+# 4096-char bucket is a 16 MB upload per dispatch.
+def _device_batch() -> int:
+    try:
+        return int(os.environ.get("BENCH_BATCH", "1024"))
+    except ValueError:
+        _log("bad BENCH_BATCH; using 1024")
+        return 1024
+
+
+def _bench_name() -> str:
+    name = os.environ.get("BENCH_CONFIG", "full")
+    if len(sys.argv) > 1:
+        name = sys.argv[1]
+    return name
+
+
+def _metric_name(name: str) -> str:
+    return (
+        "docs_per_sec_per_chip_full_danish_pipeline"
+        if name == "full"
+        else f"docs_per_sec_per_chip_{name}"
+    )
+
 # One bucket -> exactly one device program to compile.  Remote TPU compiles
 # are expensive (~minutes through the axon tunnel); the persistent cache in
 # .cache/jax makes repeat runs near-instant.
@@ -220,9 +245,7 @@ def _load_config(name: str):
 
 def main() -> int:
     os.chdir(os.path.dirname(os.path.abspath(__file__)))
-    bench_name = os.environ.get("BENCH_CONFIG", "full")
-    if len(sys.argv) > 1:
-        bench_name = sys.argv[1]
+    bench_name = _bench_name()
 
     platform, probe_failures = _resolve_platform()
     _log(f"platform: {platform}")
@@ -254,11 +277,12 @@ def main() -> int:
 
     # --- Device path: warmup (compile) then timed run.
     _log(f"device backend: {jax.default_backend()}")
+    device_batch = _device_batch()
     warm = [d.copy() for d in docs[:256]]
     t0 = time.perf_counter()
     list(
         process_documents_device(
-            config, iter(warm), device_batch=256, buckets=BUCKETS
+            config, iter(warm), device_batch=device_batch, buckets=BUCKETS
         )
     )
     warmup_s = time.perf_counter() - t0
@@ -268,7 +292,7 @@ def main() -> int:
     t0 = time.perf_counter()
     dev_outcomes = list(
         process_documents_device(
-            config, iter(run_docs), device_batch=256, buckets=BUCKETS
+            config, iter(run_docs), device_batch=device_batch, buckets=BUCKETS
         )
     )
     dev_elapsed = time.perf_counter() - t0
@@ -283,13 +307,8 @@ def main() -> int:
     )
     parity = agree / max(len(host_by_id), 1)
 
-    metric = (
-        "docs_per_sec_per_chip_full_danish_pipeline"
-        if bench_name == "full"
-        else f"docs_per_sec_per_chip_{bench_name}"
-    )
     result = {
-        "metric": metric,
+        "metric": _metric_name(bench_name),
         "value": round(dev_rate, 2),
         "unit": "docs/s",
         "vs_baseline": round(dev_rate / cpu_rate, 3),
@@ -311,7 +330,7 @@ def _fail_record(exc: BaseException) -> None:
     print(
         json.dumps(
             {
-                "metric": "docs_per_sec_per_chip_full_danish_pipeline",
+                "metric": _metric_name(_bench_name()),
                 "value": 0.0,
                 "unit": "docs/s",
                 "vs_baseline": 0.0,
@@ -324,8 +343,8 @@ def _fail_record(exc: BaseException) -> None:
 if __name__ == "__main__":
     try:
         sys.exit(main())
-    except SystemExit:
+    except (SystemExit, KeyboardInterrupt):
         raise
     except BaseException as e:  # noqa: BLE001
         _fail_record(e)
-        sys.exit(0)
+        sys.exit(1)

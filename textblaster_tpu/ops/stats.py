@@ -436,7 +436,10 @@ def gopher_quality_stats(
 
 
 def fineweb_stats(
-    st: TextStructure, stop_chars: Sequence[str], max_lines: int
+    st: TextStructure,
+    stop_chars: Sequence[str],
+    max_lines: int,
+    short_line_length: int,
 ) -> Dict[str, jax.Array]:
     """Integer stats for FineWebQualityFilter (fineweb_quality.rs:71-225)."""
     cps, cls, mask = st.cps, st.cls, st.mask
@@ -470,11 +473,16 @@ def fineweb_stats(
     total_chars_no_nl = jnp.sum(mask & ~li.is_nl, axis=1).astype(jnp.int32)
     newline_count = jnp.sum(li.is_nl, axis=1).astype(jnp.int32)
 
+    # Short-line count on device (the threshold is config-static), so the
+    # [B, ML] line tables never leave the chip (fineweb_quality.rs:126-146).
+    short_lines = jnp.sum(
+        line_has_content & (line_chars <= short_line_length), axis=1
+    ).astype(jnp.int32)
+
     return {
         "n_nonblank_lines": n_nonblank,
         "lines_ending_stop": ends_stop,
-        "line_chars": line_chars,  # [B, ML]
-        "line_has_content": line_has_content,  # [B, ML]
+        "short_lines": short_lines,
         "dup_line_bytes": dup_bytes,
         "total_chars_no_newline": total_chars_no_nl,
         "n_words": st.n_words,
@@ -578,6 +586,16 @@ def gopher_rep_stats(
 
     b, m = whash.shape
     idx = jnp.broadcast_to(jnp.arange(m, dtype=jnp.int32)[None, :], (b, m))
+    dup_sizes = sorted(set(dup_ns))
+    min_dup = dup_sizes[0] if dup_sizes else None
+
+    # Ungated jobs: every top-n job plus the SMALLEST dup-n job.  A truly
+    # duplicated n-gram contains a duplicated (n-1)-gram at the same offset,
+    # so "no dup min_dup-grams" implies no dup larger-n-grams either — the
+    # expensive larger-n sorts and the greedy-selection machinery run under a
+    # lax.cond taken only when the cheap gate fires.  (Hash-collision-only
+    # "dups" at larger n without a min_dup dup are suppressed by the gate —
+    # a strict reduction of the documented collision divergence.)
     jobs, tags = [], []
     for n in ns:
         gh, gb, win_valid = grams[n]
@@ -585,19 +603,40 @@ def gopher_rep_stats(
             # " "-joined n-grams: byte length includes n-1 single-byte spaces.
             jobs.append((gh, gb + (n - 1), win_valid))
             tags.append(("top", n))
-        if n in dup_ns:
+        if n == min_dup:
             jobs.append((gh, idx, win_valid))
             tags.append(("dup", n))
 
-    greedy_jobs = []
-    for (kind, n), st in zip(tags, _sort_runs_many(jobs)):
+    dup_min_flags = None
+    for (kind, n), srt in zip(tags, _sort_runs_many(jobs)):
         if kind == "top":
-            out[f"top_{n}"] = _top_duplicate_sorted(st)
+            out[f"top_{n}"] = _top_duplicate_sorted(srt)
         else:
-            gh, gb, win_valid = grams[n]
-            dup = _dup_flags_sorted(st, win_valid, idx)
-            greedy_jobs.append((n, dup, gb))
-    out.update(_greedy_dup_bytes_batched(greedy_jobs))
+            dup_min_flags = _dup_flags_sorted(srt, grams[n][2], idx)
+
+    if dup_sizes:
+        rest = dup_sizes[1:]
+
+        def _dup_work(dmf):
+            greedy = [(min_dup, dmf, grams[min_dup][1])]
+            if rest:
+                rjobs = [(grams[n][0], idx, grams[n][2]) for n in rest]
+                for n, srt in zip(rest, _sort_runs_many(rjobs)):
+                    greedy.append(
+                        (n, _dup_flags_sorted(srt, grams[n][2], idx), grams[n][1])
+                    )
+            res = _greedy_dup_bytes_batched(greedy)
+            return tuple(res[f"dup_{n}"] for n in dup_sizes)
+
+        def _dup_zero(dmf):
+            zero = jnp.zeros_like(n_words)
+            return tuple(zero for _ in dup_sizes)
+
+        dup_outs = jax.lax.cond(
+            jnp.any(dup_min_flags), _dup_work, _dup_zero, dup_min_flags
+        )
+        for n, v in zip(dup_sizes, dup_outs):
+            out[f"dup_{n}"] = v
     return out
 
 
