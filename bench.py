@@ -44,10 +44,13 @@ SEED = 20260729
 # 4096-char bucket is a 16 MB upload per dispatch.
 def _device_batch() -> int:
     try:
-        return int(os.environ.get("BENCH_BATCH", "1024"))
+        n = int(os.environ.get("BENCH_BATCH", "1024"))
     except ValueError:
+        n = 0
+    if n < 8:
         _log("bad BENCH_BATCH; using 1024")
         return 1024
+    return n
 
 
 def _bench_name() -> str:

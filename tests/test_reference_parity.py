@@ -1,0 +1,821 @@
+"""1:1 transcription of the reference's inline unit tests.
+
+Every ``#[test]`` / ``#[tokio::test]`` in the reference filter and text-util
+modules is transcribed here as an executable fixture, asserting the same
+decision, reason substring, rewritten content, and metadata stamps:
+
+* C4QualityFilter / C4BadWordsFilter — c4_filters.rs:554-1176
+* GopherQualityFilter               — gopher_quality.rs:321-830
+* GopherRepetitionFilter + helpers  — gopher_rep.rs:223-643
+* FineWebQualityFilter              — fineweb_quality.rs:229-604
+* text utilities                    — utils/text.rs:261-467
+
+No cargo toolchain exists in this environment, so this file is the executable
+form of differential testing against the reference: the Rust assertions are
+re-stated verbatim (values included) and must hold on the host oracle.  A
+final sweep then runs every decision case through the compiled device path
+and asserts bit-identical outcomes vs the host filters (decision, reason,
+content, metadata).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from textblaster_tpu.data_model import TextDocument
+from textblaster_tpu.errors import DocumentFiltered
+from textblaster_tpu.filters.c4_quality import C4QualityFilter
+from textblaster_tpu.filters.fineweb_quality import FineWebQualityFilter
+from textblaster_tpu.filters.gopher_quality import GopherQualityFilter
+from textblaster_tpu.filters.gopher_repetition import GopherRepetitionFilter
+from textblaster_tpu.utils.text import (
+    DANISH_STOP_WORDS,
+    PUNCTUATION,
+    find_all_duplicate,
+    find_duplicates,
+    find_top_duplicate,
+    get_n_grams,
+    split_into_sentences,
+    split_into_words,
+)
+
+
+def doc(doc_id: str, content: str, **metadata) -> TextDocument:
+    d = TextDocument(id=doc_id, source="test_source", content=content)
+    d.metadata.update(metadata)
+    return d
+
+
+def run(filt, document):
+    """(passed, reason, doc) triple from one filter application."""
+    try:
+        out = filt.process(document)
+        return True, "", out
+    except DocumentFiltered as e:
+        return False, e.reason, e.document
+
+
+# --- C4QualityFilter (c4_filters.rs:554-846) ---------------------------------
+
+def c4_default() -> C4QualityFilter:
+    """c4_filters.rs:578-591 default_filter()."""
+    return C4QualityFilter(True, True, True, 5, 3, 1000, True, True, True, True)
+
+
+_C4_SIX = (
+    "Another good line. This is the fourth sentence. And the fifth sentence. "
+    "Here is the sixth."
+)
+
+# (id, content, expect_pass, reason_substr, expect_content or None)
+C4_CASES = [
+    # test_document_passes (c4_filters.rs:592-608)
+    (
+        "pass1",
+        "This is the first sentence. This is the second sentence. This is the "
+        "third sentence. This is the fourth sentence. This is the fifth sentence.",
+        True,
+        "",
+        None,
+    ),
+    # test_too_few_sentences (c4_filters.rs:609-621)
+    (
+        "fail_sentences",
+        "One sentence. Two sentences. Three sentences. Four sentences.",
+        False,
+        "too_few_sentences (found 4, required 5)",
+        None,
+    ),
+    # test_line_too_few_words (c4_filters.rs:623-640)
+    (
+        "fail_line_words",
+        "This line is fine.\nTwo words.\n" + _C4_SIX,
+        True,
+        "",
+        "This line is fine.\n" + _C4_SIX,
+    ),
+    # test_line_missing_terminal_punctuation (c4_filters.rs:642-659)
+    (
+        "fail_line_punc",
+        "This line is fine.\nThis one is not\nAnd this is okay. Here is another "
+        "sentence. And a fifth one. This is the sixth sentence.",
+        True,
+        "",
+        "This line is fine.\nAnd this is okay. Here is another sentence. And a "
+        "fifth one. This is the sixth sentence.",
+    ),
+    # test_line_ends_with_ellipsis (c4_filters.rs:661-678)
+    (
+        "fail_line_ellipsis",
+        "This line is fine.\nThis one ends with ellipsis...\nAnd this is okay. "
+        "This is the fourth sentence. And the fifth sentence. Here is the sixth.",
+        True,
+        "",
+        "This line is fine.\nAnd this is okay. This is the fourth sentence. And "
+        "the fifth sentence. Here is the sixth.",
+    ),
+    # test_word_too_long (c4_filters.rs:680-702)
+    (
+        "fail_word_length",
+        "This line is fine.\nA line with a verylongword " + "a" * 1001 + ".\n"
+        "Another good line. This is the fourth sentence. And the fifth sentence. "
+        "Here is the sixth.",
+        True,
+        "",
+        "This line is fine.\nAnother good line. This is the fourth sentence. "
+        "And the fifth sentence. Here is the sixth.",
+    ),
+    # test_filter_lorem_ipsum (c4_filters.rs:704-716)
+    (
+        "fail_lorem_ipsum",
+        "This is fine. Lorem ipsum dolor sit amet. This is also fine.",
+        False,
+        "lorem_ipsum",
+        None,
+    ),
+    # test_filter_javascript (c4_filters.rs:718-736)
+    (
+        "fail_javascript",
+        "This is fine.\nSome javascript code here.\n" + _C4_SIX,
+        True,
+        "",
+        "This is fine.\n" + _C4_SIX,
+    ),
+    # test_filter_curly_bracket (c4_filters.rs:738-751)
+    (
+        "fail_curly_bracket",
+        "This is fine.\nSome code block {}.\nAnother good line.",
+        False,
+        "curly_bracket",
+        None,
+    ),
+    # test_filter_policy (c4_filters.rs:753-771)
+    (
+        "fail_policy",
+        "This is fine.\nRead our privacy policy.\n" + _C4_SIX,
+        True,
+        "",
+        "This is fine.\n" + _C4_SIX,
+    ),
+    # test_remove_citations (c4_filters.rs:773-791)
+    (
+        "remove_citations",
+        "This is text [1]. Another sentence [2, 3]. Final text [45]. Here is "
+        "the fourth sentence. And the fifth sentence. This is the sixth sentence.",
+        True,
+        "",
+        "This is text . Another sentence . Final text . Here is the fourth "
+        "sentence. And the fifth sentence. This is the sixth sentence.",
+    ),
+    # test_empty_document_content (c4_filters.rs:793-804)
+    ("empty_content", "", False, "too_few_sentences (found 0, required 5)", None),
+    # test_content_just_spaces (c4_filters.rs:806-818)
+    ("space_content", "   \n   ", False, "too_few_sentences (found 0, required 5)", None),
+]
+
+
+@pytest.mark.parametrize(
+    "doc_id,content,expect_pass,reason_substr,expect_content",
+    C4_CASES,
+    ids=[c[0] for c in C4_CASES],
+)
+def test_c4_reference_case(doc_id, content, expect_pass, reason_substr, expect_content):
+    passed, reason, out = run(c4_default(), doc(doc_id, content))
+    assert passed == expect_pass, f"{doc_id}: reason={reason}"
+    if reason_substr:
+        assert reason_substr in reason
+    if expect_pass:
+        assert out.metadata.get("c4_filter_status") == "passed"
+    if expect_content is not None:
+        assert out.content.strip() == expect_content.strip()
+    elif expect_pass:
+        # test_document_passes: content unchanged when no line filter fires.
+        assert out.content.strip() == content.strip()
+
+
+def test_c4_zero_min_values_pass_minimal_doc():
+    """c4_filters.rs:820-843: zero thresholds disable the checks."""
+    filt = C4QualityFilter(True, False, False, 0, 0, 0, False, False, False, False)
+    passed, reason, _ = run(filt, doc("zero_min_pass", "Ok."))
+    assert passed, reason
+
+
+# --- C4BadWordsFilter (c4_filters.rs:848-1176) -------------------------------
+
+
+def badwords_filter(tmp_path, keep_fraction, fail_on_missing, seed, default_language):
+    from textblaster_tpu.config.pipeline import C4BadWordsParams
+    from textblaster_tpu.filters.c4_badwords import C4BadWordsFilter
+
+    return C4BadWordsFilter(
+        C4BadWordsParams(
+            keep_fraction=keep_fraction,
+            fail_on_missing_language=fail_on_missing,
+            default_language=default_language,
+            seed=seed,
+            cache_base_path=tmp_path,
+        )
+    )
+
+
+def write_list(tmp_path, lang, content):
+    (tmp_path / lang).write_text(content + "\n", encoding="utf-8")
+
+
+def test_badwords_document_passes_no_badwords(tmp_path):
+    """c4_filters.rs:875-901."""
+    write_list(tmp_path, "en", "dummybadword\nexactphrase")
+    filt = badwords_filter(tmp_path, 0.0, True, 123, "en")
+    passed, _, out = run(filt, doc("bw_pass_nobadwords", "This is a clean sentence.", language="en"))
+    assert passed
+    assert out.metadata.get("c4_badwords_filter_status") == "passed"
+
+
+def test_badwords_document_filtered_has_badwords(tmp_path):
+    """c4_filters.rs:903-940."""
+    write_list(tmp_path, "en", "dummybadword\nexactphrase")
+    filt = badwords_filter(tmp_path, 0.0, True, 123, "xx")
+    passed, reason, out = run(
+        filt, doc("bw_filter_hasbadwords", "This sentence contains a dummybadword here.", language="en")
+    )
+    assert not passed
+    assert reason == "document_removed_with_badwords"
+    assert out.metadata.get("c4_badwords_filter_status") == "filtered"
+
+
+def test_badwords_keep_fraction_keeps_doc(tmp_path):
+    """c4_filters.rs:942-975: keep_fraction=1.0 always keeps."""
+    write_list(tmp_path, "en", "dummybadword\nexactphrase")
+    filt = badwords_filter(tmp_path, 1.0, True, 123, "en")
+    passed, _, out = run(filt, doc("bw_keep_fraction", "Another dummybadword sentence.", language="en"))
+    assert passed
+    assert out.metadata.get("c4_badwords_filter_status") == "passed_kept_by_fraction"
+
+
+def test_badwords_keep_fraction_filters_doc(tmp_path):
+    """c4_filters.rs:977-1008: keep_fraction=0.0 always filters."""
+    write_list(tmp_path, "en", "dummybadword\nexactphrase")
+    filt = badwords_filter(tmp_path, 0.0, True, 123, "en")
+    passed, reason, _ = run(filt, doc("bw_filter_fraction_zero", "A sentence with dummybadword.", language="en"))
+    assert not passed
+    assert reason == "document_removed_with_badwords"
+
+
+def test_badwords_missing_language_fail(tmp_path):
+    """c4_filters.rs:1010-1046."""
+    filt = badwords_filter(tmp_path, 0.0, True, 123, "en")
+    passed, reason, _ = run(filt, doc("bw_missing_lang_fail", "Some text.", language="zz"))
+    assert not passed
+    assert "There is no badwords list available for 'zz'" in reason
+
+
+def test_badwords_missing_language_pass(tmp_path):
+    """c4_filters.rs:1048-1076."""
+    filt = badwords_filter(tmp_path, 0.0, False, 123, "en")
+    passed, _, out = run(filt, doc("bw_missing_lang_pass", "Some text.", language="zz"))
+    assert passed
+    assert out.metadata.get("c4_badwords_filter_status") == "passed_no_regex"
+
+
+def test_badwords_default_language_used(tmp_path):
+    """c4_filters.rs:1078-1105."""
+    write_list(tmp_path, "de", "germanbadword")
+    filt = badwords_filter(tmp_path, 0.0, True, 123, "de")
+    passed, reason, _ = run(filt, doc("bw_default_lang", "Text with germanbadword."))
+    assert not passed
+    assert reason == "document_removed_with_badwords"
+
+
+def test_badwords_default_language_clean(tmp_path):
+    """c4_filters.rs:1107-1133."""
+    write_list(tmp_path, "de", "germanbadword")
+    filt = badwords_filter(tmp_path, 0.0, True, 123, "de")
+    passed, _, out = run(filt, doc("bw_default_lang_clean", "Clean text for default lang."))
+    assert passed
+    assert out.metadata.get("c4_badwords_filter_status") == "passed"
+
+
+def test_badwords_keep_fraction_deterministic_seed(tmp_path):
+    """c4_filters.rs:1135-1175, adapted to this build's documented RNG.
+
+    The reference draws StdRng's global f32 stream (first draw for seed 123 is
+    ~0.6689 >= 0.5 -> filtered).  This build deliberately replaces the shared
+    stream with a per-document draw, sha256(seed ':' doc_id), so decisions are
+    order- and backend-independent (filters/c4_badwords.py RNG parity note —
+    the round-2 fix for cross-backend divergence).  For this doc id the draw
+    is ~0.2294 < 0.5 -> KEPT.  The property under test — a fixed seed gives a
+    deterministic decision — is asserted against this build's documented
+    generator.
+    """
+    write_list(tmp_path, "en", "dummybadword")
+    filt = badwords_filter(tmp_path, 0.5, True, 123, "en")
+    passed, _, out = run(filt, doc("bw_deterministic_seed", "A sentence with dummybadword.", language="en"))
+    assert passed
+    assert out.metadata.get("c4_badwords_filter_status") == "passed_kept_by_fraction"
+    # Deterministic: same outcome on every evaluation.
+    filt2 = badwords_filter(tmp_path, 0.5, True, 123, "en")
+    passed2, _, _ = run(filt2, doc("bw_deterministic_seed", "A sentence with dummybadword.", language="en"))
+    assert passed2 == passed
+
+
+# --- GopherQualityFilter (gopher_quality.rs:321-830) -------------------------
+
+# (id, filter_kwargs, content, expect_pass, reason_substr)
+GQ_CASES = [
+    # test_doc_passes_permissive_filter (gopher_quality.rs:343-356)
+    ("pass_all", {}, "This is a perfectly normal document with the and of words.", True, ""),
+    # test_min_doc_words (gopher_quality.rs:359-389)
+    ("min_words_pass", {"min_doc_words": 3}, "Hello world test . !", True, ""),
+    ("min_words_fail", {"min_doc_words": 3}, "Hello world . !", False,
+     "gopher_short_doc (2 non-symbol words, required 3)"),
+    ("min_words_fail_symbols", {"min_doc_words": 3}, ". ! ?", False,
+     "gopher_short_doc (0 non-symbol words, required 3)"),
+    # test_max_doc_words (gopher_quality.rs:391-411)
+    ("max_words_pass", {"max_doc_words": 3}, "One two three .", True, ""),
+    ("max_words_fail", {"max_doc_words": 3}, "One two three four .", False,
+     "gopher_long_doc (4 non-symbol words, max 3)"),
+    # test_avg_word_length (gopher_quality.rs:414-475)
+    ("avg_len_pass", {"min_avg_word_length": 3.0, "max_avg_word_length": 5.0},
+     "cat words test .", True, ""),
+    ("avg_len_fail_min", {"min_avg_word_length": 3.0, "max_avg_word_length": 5.0},
+     "a it .", False, "gopher_below_avg_threshold (avg len 1.50, required 3.00)"),
+    ("avg_len_fail_max", {"min_avg_word_length": 3.0, "max_avg_word_length": 5.0},
+     "testing another .", False, "gopher_above_avg_threshold (avg len 7.00, max 5.00)"),
+    ("avg_len_fail_no_words", {"min_avg_word_length": 3.0, "max_avg_word_length": 5.0},
+     ". ! .", False,
+     "gopher_below_avg_threshold (avg len 0.00, required 3.00 - 0 non-symbol words)"),
+    # test_max_symbol_word_ratio_hashes (gopher_quality.rs:478-531)
+    ("hash_pass", {"max_symbol_word_ratio": 0.1},
+     "word1 word2 # word3 word4 word5 word6 word7 word8 word9 word10", True, ""),
+    ("hash_fail", {"max_symbol_word_ratio": 0.1},
+     "word1 # word2 # word3 word4 word5 word6 word7 word8", False,
+     "gopher_too_many_hashes (ratio 0.25, max 0.10)"),
+    ("hash_empty", {"max_symbol_word_ratio": 0.1}, "", True, ""),
+    ("hash_only_fail", {"max_symbol_word_ratio": 0.1}, "#", False,
+     "gopher_too_many_hashes (ratio 1.00, max 0.10)"),
+    # test_max_symbol_word_ratio_ellipsis (gopher_quality.rs:533-568)
+    ("ellipsis_pass", {"max_symbol_word_ratio": 0.1},
+     "word1 word2 ... word3 word4 word5 word6 word7 word8 word9 word10", True, ""),
+    ("ellipsis_fail", {"max_symbol_word_ratio": 0.1},
+     "word1 ... word2 … word3 word4 word5 word6 word7 word8", False,
+     "gopher_too_many_ellipsis_units (ratio 0.25, max 0.10)"),
+    # test_max_bullet_lines_ratio (gopher_quality.rs:571-615)
+    ("bullet_pass", {"max_bullet_lines_ratio": 0.5},
+     "- item 1\n- item 2\nnormal line\nanother normal line", True, ""),
+    ("bullet_fail", {"max_bullet_lines_ratio": 0.5},
+     "- item 1\n- item 2\n- item 3\nnormal line", False,
+     "gopher_too_many_bullets (ratio 0.75, max 0.50)"),
+    ("bullet_empty", {"max_bullet_lines_ratio": 0.5}, "", True, ""),
+    ("bullet_all_bullets", {"max_bullet_lines_ratio": 0.5}, "- all bullets", False,
+     "gopher_too_many_bullets (ratio 1.00, max 0.50)"),
+    # test_max_ellipsis_lines_ratio (gopher_quality.rs:617-644)
+    ("ell_lines_pass", {"max_ellipsis_lines_ratio": 0.5},
+     "Line one...\nLine two…\nNormal line\nAnother normal", True, ""),
+    ("ell_lines_fail", {"max_ellipsis_lines_ratio": 0.5},
+     "Line one...\nLine two…\nLine three...\nNormal line", False,
+     "gopher_too_many_end_ellipsis_lines (ratio 0.75, max 0.50)"),
+    # test_alphabetic_word_ratio (gopher_quality.rs:647-760)
+    ("alpha_pass", {"max_non_alpha_words_ratio": 0.5}, "word 123 word !!!", True, ""),
+    ("alpha_fail", {"max_non_alpha_words_ratio": 0.5}, "word 123 456 !!!", False,
+     "gopher_below_alpha_threshold (alpha ratio 0.33, required min 0.50)"),
+    ("alpha_all_non_alpha", {"max_non_alpha_words_ratio": 0.5}, "123 456 789 !!!", False,
+     "gopher_below_alpha_threshold (alpha ratio 0.00, required min 0.50)"),
+    ("alpha_empty_fail", {"max_non_alpha_words_ratio": 0.5}, "", False,
+     "gopher_below_alpha_threshold (alpha ratio 0.00, required min 0.50)"),
+    # test_stop_word_presence (gopher_quality.rs:764-829)
+    ("sw_pass_default", {"min_stop_words": 2}, "the quick brown fox and the lazy dog", True, ""),
+    ("sw_fail_default", {"min_stop_words": 2}, "a quick brown fox is lazy", False,
+     "gopher_too_few_stop_words (found 0, required 2)"),
+    ("sw_pass_custom", {"min_stop_words": 1, "stop_words": ["custom", "words"]},
+     "this is a custom test with other words", True, ""),
+    ("sw_fail_custom", {"min_stop_words": 1, "stop_words": ["custom", "words"]},
+     "this is a regular sentence", False,
+     "gopher_too_few_stop_words (found 0, required 1)"),
+    ("sw_zero_needed", {"min_stop_words": 0}, "no stop words here", True, ""),
+    ("sw_none_needed", {}, "no stop words here", True, ""),
+]
+
+
+@pytest.mark.parametrize(
+    "doc_id,kwargs,content,expect_pass,reason_substr",
+    GQ_CASES,
+    ids=[c[0] for c in GQ_CASES],
+)
+def test_gopher_quality_reference_case(doc_id, kwargs, content, expect_pass, reason_substr):
+    passed, reason, _ = run(GopherQualityFilter(**kwargs), doc(doc_id, content))
+    assert passed == expect_pass, f"{doc_id}: reason={reason}"
+    if reason_substr:
+        assert reason_substr in reason, f"{doc_id}: reason={reason}"
+
+
+# --- GopherRepetitionFilter helpers (gopher_rep.rs:246-408) ------------------
+
+
+def test_get_n_grams_logic():
+    """gopher_rep.rs:246-270."""
+    words = ["a", "b", "c", "d"]
+    assert get_n_grams(words, 2) == ["a b", "b c", "c d"]
+    assert get_n_grams(words, 1) == ["a", "b", "c", "d"]
+    assert get_n_grams(words, 4) == ["a b c d"]
+    assert get_n_grams(words, 5) == []
+    assert get_n_grams([], 2) == []
+    assert get_n_grams(words, 0) == []
+
+
+def test_find_duplicates_logic():
+    """gopher_rep.rs:272-297."""
+    assert find_duplicates(["a", "b", "c"]) == (0, 0)
+    assert find_duplicates(["a", "b", "a"]) == (1, 1)
+    assert find_duplicates(["ab", "cd", "ab", "ef", "cd"]) == (2, 4)
+    assert find_duplicates(["a", "a", "a"]) == (2, 2)
+    assert find_duplicates([]) == (0, 0)
+
+
+def test_find_top_duplicate_logic():
+    """gopher_rep.rs:299-352."""
+    assert find_top_duplicate(["a", "a"]) == 2
+    assert find_top_duplicate(["a", "a", "b", "b"]) == 2
+    assert find_top_duplicate(["a b", "c d", "a b"]) == 6
+    assert find_top_duplicate(["a", "b", "c"]) == 0
+    assert find_top_duplicate(["aa", "aa", "b", "b"]) == 4
+    assert find_top_duplicate(["a", "a", "a"]) == 3
+    assert find_top_duplicate([]) == 0
+    assert find_top_duplicate(["unique"]) == 0
+
+
+def test_find_all_duplicate_no_dups():
+    """gopher_rep.rs:354-366."""
+    words = ["a", "b", "c", "d", "e"]
+    assert find_all_duplicate(words, 2) == 0
+    assert find_all_duplicate(words, 3) == 0
+
+
+def test_find_all_duplicate_simple_dups():
+    """gopher_rep.rs:368-372."""
+    assert find_all_duplicate(["a", "b", "c", "a", "b", "d"], 2) == 2
+
+
+def test_find_all_duplicate_multiple_dups():
+    """gopher_rep.rs:374-378."""
+    assert find_all_duplicate(["a", "b", "a", "b", "a", "b"], 2) == 4
+
+
+def test_find_all_duplicate_repeated_single_char_ngram():
+    """gopher_rep.rs:380-388."""
+    assert find_all_duplicate(["a", "a", "a", "a", "a"], 2) == 4
+
+
+def test_find_all_duplicate_edge_cases():
+    """gopher_rep.rs:390-403."""
+    words = ["a", "b", "c", "d", "e"]
+    assert find_all_duplicate([], 2) == 0
+    assert find_all_duplicate(words, 0) == 0
+    assert find_all_duplicate(words, 6) == 0
+
+
+# --- GopherRepetitionFilter process (gopher_rep.rs:406-643) ------------------
+
+_PARA1 = "This is the first paragraph."
+_PARA2 = "This is the second paragraph."
+_LINE1 = "This is line one."
+_LINE2 = "This is line two."
+
+# char fraction setups (gopher_rep.rs:451-456, 520-527): threshold set just
+# below the actual ratio so the check fires.
+_PARA_CHAR_CONTENT = f"{_PARA1}\n\n{_PARA1}\n\n{_PARA1}"
+_PARA_CHAR_THR = (2 * len(_PARA1)) / len(_PARA_CHAR_CONTENT) - 0.01
+_LINE_CHAR_CONTENT = f"{_LINE1}\n{_LINE1}\n{_LINE1}"
+_LINE_CHAR_THR = (2 * len(_LINE1)) / max(len(_LINE_CHAR_CONTENT), 1) - 0.01
+
+GR_CASES = [
+    # test_rep_filter_passes_permissive (gopher_rep.rs:406-414)
+    ("pass_rep", {},
+     "This is a normal document.\nIt has multiple lines.\n\nAnd multiple paragraphs.",
+     True, ""),
+    # test_duplicate_paragraphs (gopher_rep.rs:417-476)
+    ("para_pass_frac", {"dup_para_frac": 0.3},
+     f"{_PARA1}\n\n{_PARA2}\n\nAnother unique.", True, ""),
+    ("para_fail_frac", {"dup_para_frac": 0.3},
+     f"{_PARA1}\n\n{_PARA2}\n\n{_PARA1}", False,
+     "dup_para_frac (ratio 0.33, max 0.30)"),
+    ("para_pass_char_frac", {"dup_para_char_frac": _PARA_CHAR_THR},
+     f"{_PARA1}\n\n{_PARA2}\n\nAnother unique.", True, ""),
+    ("para_fail_char_frac", {"dup_para_char_frac": _PARA_CHAR_THR},
+     _PARA_CHAR_CONTENT, False, "dup_para_char_frac"),
+    # test_duplicate_lines (gopher_rep.rs:479-565)
+    ("line_pass_frac", {"dup_line_frac": 0.3},
+     f"{_LINE1}\n{_LINE2}\nUnique line", True, ""),
+    ("line_fail_frac", {"dup_line_frac": 0.3},
+     f"{_LINE1}\n{_LINE2}\n{_LINE1}", False,
+     "dup_line_frac (ratio 0.33, max 0.30)"),
+    ("line_pass_char_frac", {"dup_line_char_frac": _LINE_CHAR_THR},
+     f"{_LINE1}\n{_LINE2}\nUnique line", True, ""),
+    ("line_fail_char_frac", {"dup_line_char_frac": _LINE_CHAR_THR},
+     _LINE_CHAR_CONTENT, False, "dup_line_char_frac (ratio"),
+    # test_top_n_grams (gopher_rep.rs:568-607)
+    ("top_ngram_pass", {"top_n_grams": [(2, 0.3)]}, "a b c d e f a b g h i j", True, ""),
+    ("top_ngram_fail", {"top_n_grams": [(2, 0.3)]}, "a b c a b d a b e a b", False,
+     "top_2_gram"),
+    # test_duplicate_n_grams (gopher_rep.rs:609-642)
+    ("dup_ngram_fail", {"dup_n_grams": [(2, 0.1)]}, "a b c d e a b f g", False,
+     "duplicated_2_n_grams"),
+    ("dup_ngram_pass", {"dup_n_grams": [(2, 0.1)]}, "a b c d e f g h i", True, ""),
+]
+
+
+@pytest.mark.parametrize(
+    "doc_id,kwargs,content,expect_pass,reason_substr",
+    GR_CASES,
+    ids=[c[0] for c in GR_CASES],
+)
+def test_gopher_rep_reference_case(doc_id, kwargs, content, expect_pass, reason_substr):
+    passed, reason, _ = run(GopherRepetitionFilter(**kwargs), doc(doc_id, content))
+    assert passed == expect_pass, f"{doc_id}: reason={reason}"
+    if reason_substr:
+        assert reason_substr in reason, f"{doc_id}: reason={reason}"
+
+
+# --- FineWebQualityFilter (fineweb_quality.rs:229-604) -----------------------
+
+
+def fineweb(**overrides) -> FineWebQualityFilter:
+    """fineweb_quality.rs:243-254 default_filter() (char_dup 0.95)."""
+    kwargs = dict(
+        line_punct_thr=0.12,
+        line_punct_exclude_zero=False,
+        short_line_thr=0.67,
+        short_line_length=30,
+        char_duplicates_ratio=0.95,
+        new_line_ratio=0.3,
+    )
+    kwargs.update(overrides)
+    return FineWebQualityFilter(**kwargs)
+
+
+FW_CASES = [
+    # test_empty_document_content (fineweb_quality.rs:268-279)
+    ("empty_doc", {}, "", False, "empty"),
+    # test_whitespace_only_document_content (fineweb_quality.rs:281-291)
+    ("whitespace_doc", {}, "   \n\t   \n ", False, "empty"),
+    # test_line_punct_ratio_fail_low_ratio (fineweb_quality.rs:294-307)
+    ("punct_fail_low", {},
+     "Line one\nLine two\nLine three\nLine four\nLine five\nLine six\nLine seven"
+     "\nLine eight\nLine nine\nLine ten.",
+     False, "line_punct_ratio: 0.1000 < threshold 0.1200"),
+    # test_line_punct_ratio_pass (fineweb_quality.rs:309-318)
+    ("punct_pass", {"short_line_thr": 1.0},
+     "Line one is long enough and ends with a period.\nLine two is also long "
+     "enough and ends with a question mark?\nLine three is also very long "
+     "indeed and ends with an exclamation mark!",
+     True, ""),
+    # test_line_punct_ratio_zero_ratio_exclude_zero_true (fineweb_quality.rs:320-331)
+    ("punct_zero_exclude_true", {"line_punct_exclude_zero": True, "short_line_thr": 1.0},
+     "Looooooooong line one, no punctuation here\nLooooooooong line two, also "
+     "no punctuation\nLooooooooong line three, definitely no punctuation",
+     True, ""),
+    # test_line_punct_ratio_zero_ratio_exclude_zero_false (fineweb_quality.rs:333-349)
+    ("punct_zero_exclude_false", {},
+     "Line one\nLine two\nLine three",
+     False, "line_punct_ratio: 0.0000 < threshold 0.1200"),
+    # test_short_line_ratio_fail (fineweb_quality.rs:352-366)
+    ("short_line_fail", {},
+     "Short line.\nThis is another short one.\nWay too short.\nThis line is "
+     "definitely longer than thirty characters to provide some balance.",
+     False, "short_line_ratio: 0.7500 > threshold 0.6700"),
+    # test_short_line_ratio_pass (fineweb_quality.rs:368-381)
+    ("short_line_pass_punctuated", {},
+     "This line is adequately long and should pass.\nSo is this one, it meets "
+     "the criteria perfectly.\nAnd another one just to be sure it's fine.",
+     True, ""),
+    # test_char_dup_ratio_pass_no_duplicates (fineweb_quality.rs:410-424)
+    ("char_dup_pass_none",
+     {"line_punct_thr": 0.0, "short_line_thr": 1.0, "new_line_ratio": 1.0},
+     "abcdefghijklmnopqrstuvwxyz.\n1234567890.", True, ""),
+    # test_char_dup_ratio_pass_low_duplicates (fineweb_quality.rs:426-435)
+    ("char_dup_pass_low_actual", {"line_punct_thr": 0.0, "short_line_thr": 1.0},
+     "abcde fghij klmno pqrst uvwxyz.", True, ""),
+    # test_char_dup_ratio_all_same_char_fail (fineweb_quality.rs:437-462)
+    ("char_dup_all_same",
+     {"line_punct_thr": 0.0, "short_line_thr": 1.0, "new_line_ratio": 1.0,
+      "char_duplicates_ratio": 0.66},
+     "Hello World\nHello World\nHello World",
+     False, "char_dup_ratio: 0.6667 > threshold 0.6600"),
+    # test_new_line_ratio_fail (fineweb_quality.rs:489-508)
+    ("new_line_fail", {"line_punct_thr": 0.0, "short_line_thr": 1.0},
+     "word.\nword.\nword.\nword.\nword.",
+     False, "list_ratio: 0.8000 > threshold 0.3000"),
+    # test_new_line_ratio_pass case 1 (fineweb_quality.rs:510-518)
+    ("new_line_pass_single_line", {},
+     "Many words on a single line with no newlines effectively. This should "
+     "pass easily.",
+     True, ""),
+    # test_new_line_ratio_pass case 2 (fineweb_quality.rs:520-526)
+    ("new_line_pass_some", {},
+     "Word one is long enough and ends with a period.\nWord two is also quite "
+     "long and ends with a period.\nWord three is suitably lengthy and ends "
+     "with a period.\nWord four and five and six are here and it ends with a "
+     "period.",
+     True, ""),
+    # test_new_line_ratio_no_words_fail (fineweb_quality.rs:528-541)
+    ("new_line_no_words", {}, "\n\n\n", False, "empty"),
+    # test_new_line_ratio_no_words_no_newlines (fineweb_quality.rs:543-566)
+    ("new_line_no_words_no_nl", {}, "... --- !!!",
+     False, "short_line_ratio: 1.0000 > threshold 0.6700"),
+    # test_passing_document (fineweb_quality.rs:569-603)
+    ("passing_doc", {},
+     "This is a good line that ends with a period.\nAnother good line also "
+     "ends with a question mark?\nShort lines are not too frequent here, which "
+     "is great!\nCharacter duplication is hopefully not too high in this "
+     "example text.\nAnd the ratio of newlines to words should be reasonable "
+     "as well.",
+     True, ""),
+]
+
+
+@pytest.mark.parametrize(
+    "doc_id,overrides,content,expect_pass,reason_substr",
+    FW_CASES,
+    ids=[c[0] for c in FW_CASES],
+)
+def test_fineweb_reference_case(doc_id, overrides, content, expect_pass, reason_substr):
+    passed, reason, _ = run(fineweb(**overrides), doc(doc_id, content))
+    assert passed == expect_pass, f"{doc_id}: reason={reason}"
+    if reason_substr:
+        assert reason.startswith(reason_substr) or reason == reason_substr, (
+            f"{doc_id}: reason={reason}"
+        )
+
+
+# --- Text utilities (utils/text.rs:261-467) ----------------------------------
+
+
+def test_split_sentences_empty_and_simple():
+    """text.rs:266-305."""
+    assert split_into_sentences("") == []
+    assert split_into_sentences("   ") == []
+    assert split_into_sentences("Hello world.") == ["Hello world."]
+    assert split_into_sentences("  Hello world.  ") == ["Hello world."]
+    assert split_into_sentences("Dette er en sætning.") == ["Dette er en sætning."]
+    assert split_into_sentences("SingleWord") == ["SingleWord"]
+    assert split_into_sentences("  SingleWord  ") == ["SingleWord"]
+
+
+def test_split_sentences_multiple():
+    """text.rs:307-345."""
+    expected = ["Første sætning.", "Anden sætning!", "Tredje sætning?"]
+    assert split_into_sentences("Første sætning. Anden sætning! Tredje sætning?") == expected
+    assert split_into_sentences("  Første sætning.   Anden sætning!  Tredje sætning?  ") == expected
+    assert split_into_sentences(" Hello. How are you? Fine! ") == ["Hello.", "How are you?", "Fine!"]
+    assert split_into_sentences("This is a sentence. This is another") == [
+        "This is a sentence.", "This is another"]
+    assert split_into_sentences("  This is a sentence.   This is another  ") == [
+        "This is a sentence.", "This is another"]
+
+
+def test_split_words_empty_and_simple():
+    """text.rs:347-351."""
+    assert split_into_words("") == []
+    assert split_into_words("hello") == ["hello"]
+    assert split_into_words("hello world") == ["hello", "world"]
+
+
+def test_split_words_with_punctuation():
+    """text.rs:353-427."""
+    assert split_into_words("hello, world!") == ["hello", "world"]
+    assert split_into_words("first. second; third?") == ["first", "second", "third"]
+    assert split_into_words("...leading") == ["leading"]
+    assert split_into_words("trailing...") == ["trailing"]
+    assert split_into_words("mid...dle") == ["mid", "dle"]
+
+
+def test_split_words_danish():
+    """text.rs:429-433."""
+    assert split_into_words("hej med dig") == ["hej", "med", "dig"]
+    assert split_into_words("en, to, tre!") == ["en", "to", "tre"]
+
+
+def test_punctuation_set_contents():
+    """text.rs:435-448."""
+    for ch in (".", ",", "!", "?", '"', "\x00", "\x1f"):
+        assert ch in PUNCTUATION
+    for ch in ("a", "A", "5"):
+        assert ch not in PUNCTUATION
+
+
+def test_danish_stop_words_simple_check():
+    """text.rs:450-457."""
+    assert len(DANISH_STOP_WORDS) > 0
+    assert "og" in DANISH_STOP_WORDS
+    assert "er" in DANISH_STOP_WORDS
+    assert "hest" not in DANISH_STOP_WORDS
+
+
+# --- Device-path sweep -------------------------------------------------------
+# Every decision case above also runs through the compiled device pipeline;
+# outcomes (kind, reason, content, metadata) must be bit-identical to the
+# host filters that the reference assertions validated.
+
+
+def _device_outcomes(step_type, params_obj, docs):
+    from textblaster_tpu.config.pipeline import PipelineConfig, StepConfig
+    from textblaster_tpu.ops.pipeline import process_documents_device
+
+    config = PipelineConfig(pipeline=[StepConfig(type=step_type, params=params_obj)])
+    return {
+        o.document.id: o
+        for o in process_documents_device(
+            config, iter(docs), device_batch=8, buckets=(2048,)
+        )
+    }
+
+
+def _host_outcomes(filt, docs):
+    out = {}
+    for d in docs:
+        passed, reason, res = run(filt, d)
+        out[d.id] = (passed, reason, res.content, dict(res.metadata))
+    return out
+
+
+def _assert_same(host, device):
+    assert set(host) == set(device)
+    for doc_id, (passed, reason, content, meta) in host.items():
+        o = device[doc_id]
+        kind = "Success" if passed else "Filtered"
+        assert o.kind == kind, f"{doc_id}: device={o.kind} host={kind} ({reason})"
+        if not passed:
+            assert o.reason == reason, f"{doc_id}: {o.reason!r} != {reason!r}"
+        assert o.document.content == content, doc_id
+        assert dict(o.document.metadata) == meta, doc_id
+
+
+def test_device_sweep_c4():
+    from textblaster_tpu.config.pipeline import C4QualityParams
+
+    docs_host = [doc(i, c) for i, c, *_ in C4_CASES]
+    docs_dev = [doc(i, c) for i, c, *_ in C4_CASES]
+    host = _host_outcomes(c4_default(), docs_host)
+    params = C4QualityParams(True, True, True, 5, 3, 1000, True, True, True, True)
+    _assert_same(host, _device_outcomes("C4QualityFilter", params, docs_dev))
+
+
+def test_device_sweep_gopher_quality():
+    from textblaster_tpu.config.pipeline import GopherQualityParams
+
+    by_cfg = {}
+    for doc_id, kwargs, content, *_ in GQ_CASES:
+        key = tuple(sorted((k, tuple(v) if isinstance(v, list) else v) for k, v in kwargs.items()))
+        by_cfg.setdefault(key, (kwargs, []))[1].append((doc_id, content))
+    for kwargs, cases in by_cfg.values():
+        host = _host_outcomes(
+            GopherQualityFilter(**kwargs), [doc(i, c) for i, c in cases]
+        )
+        device = _device_outcomes(
+            "GopherQualityFilter",
+            GopherQualityParams(**kwargs),
+            [doc(i, c) for i, c in cases],
+        )
+        _assert_same(host, device)
+
+
+def test_device_sweep_gopher_rep():
+    from textblaster_tpu.config.pipeline import GopherRepetitionParams
+
+    by_cfg = {}
+    for doc_id, kwargs, content, *_ in GR_CASES:
+        key = tuple(sorted((k, tuple(map(tuple, v)) if isinstance(v, list) else v) for k, v in kwargs.items()))
+        by_cfg.setdefault(key, (kwargs, []))[1].append((doc_id, content))
+    for kwargs, cases in by_cfg.values():
+        host = _host_outcomes(
+            GopherRepetitionFilter(**kwargs), [doc(i, c) for i, c in cases]
+        )
+        device = _device_outcomes(
+            "GopherRepetitionFilter",
+            GopherRepetitionParams(**kwargs),
+            [doc(i, c) for i, c in cases],
+        )
+        _assert_same(host, device)
+
+
+def test_device_sweep_fineweb():
+    from textblaster_tpu.config.pipeline import FineWebQualityFilterParams
+
+    by_cfg = {}
+    for doc_id, overrides, content, *_ in FW_CASES:
+        key = tuple(sorted(overrides.items()))
+        by_cfg.setdefault(key, (overrides, []))[1].append((doc_id, content))
+    for overrides, cases in by_cfg.values():
+        filt = fineweb(**overrides)
+        params = FineWebQualityFilterParams(
+            line_punct_thr=filt.line_punct_thr,
+            line_punct_exclude_zero=filt.line_punct_exclude_zero,
+            short_line_thr=filt.short_line_thr,
+            short_line_length=filt.short_line_length,
+            char_duplicates_ratio=filt.char_duplicates_ratio,
+            new_line_ratio=filt.new_line_ratio,
+        )
+        host = _host_outcomes(filt, [doc(i, c) for i, c in cases])
+        device = _device_outcomes(
+            "FineWebQualityFilter", params, [doc(i, c) for i, c in cases]
+        )
+        _assert_same(host, device)

@@ -596,6 +596,11 @@ def gopher_rep_stats(
     # lax.cond taken only when the cheap gate fires.  (Hash-collision-only
     # "dups" at larger n without a min_dup dup are suppressed by the gate —
     # a strict reduction of the documented collision divergence.)
+    #
+    # The gate is batch-global (one dirty row runs the branch for the whole
+    # batch); it pays off for clean or small batches — parity suites, shards
+    # of already-deduped text — while dirty web-scale batches cost one extra
+    # sort dispatch over the ungated form.
     jobs, tags = [], []
     for n in ns:
         gh, gb, win_valid = grams[n]
@@ -608,7 +613,7 @@ def gopher_rep_stats(
             tags.append(("dup", n))
 
     dup_min_flags = None
-    for (kind, n), srt in zip(tags, _sort_runs_many(jobs)):
+    for (kind, n), srt in zip(tags, _sort_runs_many(jobs) if jobs else ()):
         if kind == "top":
             out[f"top_{n}"] = _top_duplicate_sorted(srt)
         else:
