@@ -19,22 +19,35 @@ Rows are independent; the grid tiles the batch dimension.  Row length must be
 a power of two (all duplicate tables in :mod:`.stats` are sized to powers of
 two by ``pipeline._table_sizes``).
 
-``sort3()`` transparently falls back to ``lax.sort`` off-TPU or if the Pallas
-lowering probe fails, so CPU tests and degraded environments keep working.
+Multi-device: Mosaic ``pallas_call`` custom calls carry no GSPMD partitioning
+rule, so a program jitted with multi-device ``in_shardings`` cannot contain a
+bare one.  ``sort2``/``sort3`` therefore take the target ``mesh`` explicitly
+and wrap the kernel in ``shard_map`` over the data axis — each device sorts
+its own row shard in VMEM; rows never cross devices, so no collective beyond
+the resharding (if any) is inserted.  Off-TPU or for shapes the kernel cannot
+tile, both fall back to ``lax.sort``, which partitions fine under GSPMD.
+
+``TEXTBLAST_PALLAS_INTERPRET=1`` forces the Pallas *interpret* path on any
+backend — used by the CPU-mesh tests to exercise the exact shard_map +
+pallas_call program the TPU runs, minus the Mosaic lowering.
 """
 
 from __future__ import annotations
 
-import contextlib
 import functools
 import logging
 import os
-import threading
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # moved out of experimental in newer JAX
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
 
 try:  # pltpu is importable on all platforms; lowering is TPU-only.
     from jax.experimental.pallas import tpu as pltpu
@@ -43,27 +56,17 @@ except ImportError:  # pragma: no cover
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["sort3", "pallas_sort3", "pallas_sort_supported", "pallas_allowed"]
+__all__ = ["sort2", "sort3", "pallas_sort2", "pallas_sort3", "pallas_sort_supported"]
 
 _ROWS = 8  # sublane tile for int32
 
-_tls = threading.local()
+#: Mesh axis the batch dimension is sharded over (parallel.mesh.DATA_AXIS;
+#: duplicated here to keep this module importable standalone).
+_DATA_AXIS = "data"
 
 
-@contextlib.contextmanager
-def pallas_allowed(allowed: bool):
-    """Scope the Pallas fast path (default allowed).
-
-    Mosaic ``pallas_call`` custom calls carry no GSPMD partitioning rule, so a
-    program jitted with multi-device ``in_shardings`` must not contain them —
-    the compiled pipeline traces its stages under ``pallas_allowed(False)``
-    whenever it targets a >1-device mesh, falling back to ``lax.sort``."""
-    prev = getattr(_tls, "allowed", True)
-    _tls.allowed = allowed and prev
-    try:
-        yield
-    finally:
-        _tls.allowed = prev
+def _interpret_forced() -> bool:
+    return bool(os.environ.get("TEXTBLAST_PALLAS_INTERPRET"))
 
 
 def _lex_gt(a: Tuple[jax.Array, ...], b: Tuple[jax.Array, ...]) -> jax.Array:
@@ -72,6 +75,14 @@ def _lex_gt(a: Tuple[jax.Array, ...], b: Tuple[jax.Array, ...]) -> jax.Array:
     for x, y in zip(reversed(a[:-1]), reversed(b[:-1])):
         gt = (x > y) | ((x == y) & gt)
     return gt
+
+
+def _roll(k: jax.Array, shift: int) -> jax.Array:
+    if pltpu is not None:
+        # Works under interpret mode too (generic lowering == jnp.roll), so
+        # the CPU-mesh tests run the same kernel program the TPU lowers.
+        return pltpu.roll(k, shift=shift, axis=1)
+    return jnp.roll(k, shift, axis=1)  # pragma: no cover - pltpu unavailable
 
 
 def _bitonic_kernel(*refs):
@@ -93,11 +104,7 @@ def _bitonic_kernel(*refs):
             # pltpu.roll requires non-negative shifts; left-roll by `stride`
             # is a right-roll by `m - stride`.
             partners = tuple(
-                jnp.where(
-                    is_lower,
-                    pltpu.roll(k, shift=m - stride, axis=1),
-                    pltpu.roll(k, shift=stride, axis=1),
-                )
+                jnp.where(is_lower, _roll(k, m - stride), _roll(k, stride))
                 for k in ks
             )
             lower = tuple(jnp.where(is_lower, k, p) for k, p in zip(ks, partners))
@@ -151,10 +158,7 @@ def pallas_sort2(
 
 
 @functools.lru_cache(maxsize=1)
-def pallas_sort_supported() -> bool:
-    """Probe whether the Pallas kernel lowers and runs on this backend."""
-    if os.environ.get("TEXTBLAST_NO_PALLAS"):
-        return False
+def _probe_backend() -> bool:
     if pltpu is None or jax.default_backend() == "cpu":
         return False
     try:
@@ -166,24 +170,67 @@ def pallas_sort_supported() -> bool:
         return False
 
 
+def pallas_sort_supported() -> bool:
+    """Whether the Pallas kernel can run here.  Env-dependent decisions are
+    re-read on every call (only the backend lowering probe is cached), so a
+    test or embedder toggling the env vars cannot be poisoned by a stale
+    cached answer."""
+    if os.environ.get("TEXTBLAST_NO_PALLAS"):
+        return False
+    if _interpret_forced():
+        return True
+    return _probe_backend()
+
+
 def _pallas_ok(b: int, m: int) -> bool:
     return (
-        getattr(_tls, "allowed", True)
-        and pallas_sort_supported()
+        pallas_sort_supported()
         and m >= 128
         and not (m & (m - 1))
         and b % _ROWS == 0
+        and b > 0
     )
 
 
+def _mesh_size(mesh: Optional[Mesh]) -> int:
+    return 1 if mesh is None else mesh.devices.size
+
+
+def _sharded_sort(fn, mesh: Mesh, ks):
+    """Run ``fn`` (a pallas sort over the local shard) under shard_map, rows
+    sharded along the data axis, each device's shard VMEM-resident."""
+    spec = P(_DATA_AXIS, None)
+    n = len(ks)
+    kwargs = dict(mesh=mesh, in_specs=(spec,) * n, out_specs=(spec,) * n)
+    try:
+        # Replication checking needs vma annotations pallas outputs don't
+        # carry; rows are fully sharded, nothing is replicated — disable it.
+        mapped = shard_map(fn, check_vma=False, **kwargs)
+    except TypeError:  # pre-vma JAX spells it check_rep
+        mapped = shard_map(fn, check_rep=False, **kwargs)
+    return mapped(*ks)
+
+
+def _dispatch(*ks) -> Tuple[jax.Array, ...]:
+    interpret = _interpret_forced()
+    return tuple(_pallas_sort_n(ks, interpret=interpret))
+
+
 def sort3(
-    k1: jax.Array, k2: jax.Array, k3: jax.Array
+    k1: jax.Array,
+    k2: jax.Array,
+    k3: jax.Array,
+    mesh: Optional[Mesh] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Lexicographic row sort: Pallas bitonic network on TPU, ``lax.sort``
-    elsewhere."""
+    """Lexicographic row sort: Pallas bitonic network on TPU (shard_mapped
+    over ``mesh`` when given), ``lax.sort`` elsewhere."""
     b, m = k1.shape
-    if _pallas_ok(b, m):
-        return pallas_sort3(k1, k2, k3)
+    n_dev = _mesh_size(mesh)
+    if n_dev > 1:
+        if b % n_dev == 0 and _pallas_ok(b // n_dev, m):
+            return _sharded_sort(_dispatch, mesh, (k1, k2, k3))
+    elif _pallas_ok(b, m):
+        return pallas_sort3(k1, k2, k3, interpret=_interpret_forced())
     return jax.lax.sort(
         (k1.astype(jnp.int32), k2.astype(jnp.int32), k3.astype(jnp.int32)),
         dimension=1,
@@ -191,7 +238,9 @@ def sort3(
     )
 
 
-def sort2(k1: jax.Array, k2: jax.Array) -> Tuple[jax.Array, jax.Array]:
+def sort2(
+    k1: jax.Array, k2: jax.Array, mesh: Optional[Mesh] = None
+) -> Tuple[jax.Array, jax.Array]:
     """Row sort by key ``k1`` carrying ``k2``, deterministic within equal
     keys: ascending ``k2`` order.
 
@@ -201,8 +250,12 @@ def sort2(k1: jax.Array, k2: jax.Array) -> Tuple[jax.Array, jax.Array]:
     sorting the full ``(k1, k2)`` pair, which is equivalent up to within-run
     payload order (and exactly equal for iota payloads)."""
     b, m = k1.shape
-    if _pallas_ok(b, m):
-        return pallas_sort2(k1, k2)
+    n_dev = _mesh_size(mesh)
+    if n_dev > 1:
+        if b % n_dev == 0 and _pallas_ok(b // n_dev, m):
+            return _sharded_sort(_dispatch, mesh, (k1, k2))
+    elif _pallas_ok(b, m):
+        return pallas_sort2(k1, k2, interpret=_interpret_forced())
     return jax.lax.sort(
         (k1.astype(jnp.int32), k2.astype(jnp.int32)),
         dimension=1,

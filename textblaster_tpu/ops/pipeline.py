@@ -249,13 +249,12 @@ class CompiledPipeline:
             elif step.type == "C4BadWordsFilter":
                 plans.append(("badwords", i, _badwords_tables(step)))
 
-        # Mosaic pallas_call has no GSPMD partitioning rule: under a
-        # multi-device mesh every stage must trace the lax.sort fallback.
-        single_device = self.mesh is None or self.mesh.devices.size == 1
+        # Mosaic pallas_call has no GSPMD partitioning rule, so multi-device
+        # programs run the sort kernels under shard_map over the data axis —
+        # the stats entry points take the mesh explicitly (pallas_sort.sort2).
+        mesh = self.mesh if self.mesh is not None and self.mesh.devices.size > 1 else None
 
         def fn(cps, lengths):
-            from .pallas_sort import pallas_allowed
-
             out: Dict[str, jax.Array] = {}
             state = {"cps": cps, "lengths": lengths, "st": None}
 
@@ -264,8 +263,7 @@ class CompiledPipeline:
                     state["st"] = structure(state["cps"], state["lengths"])
                 return state["st"]
 
-            with pallas_allowed(single_device):
-                return _eval_plans(plans, state, out, get_structure, max_lines, max_words)
+            return _eval_plans(plans, state, out, get_structure, max_lines, max_words)
 
         def _eval_plans(plans, state, out, get_structure, max_lines, max_words):
             for kind, i, arg in plans:
@@ -279,7 +277,8 @@ class CompiledPipeline:
                 elif kind == "gopher_rep":
                     top_ns, dup_ns = arg
                     stats = gopher_rep_stats(
-                        get_structure(), top_ns, dup_ns, max_lines, max_words
+                        get_structure(), top_ns, dup_ns, max_lines, max_words,
+                        mesh=mesh,
                     )
                     for k, v in stats.items():
                         out[f"{i}:{k}"] = v
@@ -294,7 +293,9 @@ class CompiledPipeline:
                     state.update(cps=new_cps, lengths=new_lengths, st=None)
                 elif kind == "fineweb":
                     stop_chars, short_len = arg
-                    fw = fineweb_stats(get_structure(), stop_chars, max_lines, short_len)
+                    fw = fineweb_stats(
+                        get_structure(), stop_chars, max_lines, short_len, mesh=mesh
+                    )
                     for k, v in fw.items():
                         out[f"{i}:{k}"] = v
                 elif kind == "badwords":

@@ -282,7 +282,7 @@ def _last_nonws_in_line(nonws: jax.Array, li: LineInfo, mask: jax.Array) -> jax.
 _I32_MAX = np.int32(2**31 - 1)
 
 
-def _sort_runs_many(jobs):
+def _sort_runs_many(jobs, mesh=None):
     """Sort many same-shaped ``(hash, payload, valid)`` jobs in ONE device
     sort, returning ``(is_real, s_hash, s_payload)`` per job.
 
@@ -306,11 +306,12 @@ def _sort_runs_many(jobs):
         keys.append(jnp.where(v, jnp.minimum(h, _I32_MAX - 1), _I32_MAX))
         n_valid.append(jnp.sum(v, axis=1).astype(jnp.int32))
     if len(jobs) == 1:
-        s_key, s_payload = sort2(keys[0], jobs[0][1])
+        s_key, s_payload = sort2(keys[0], jobs[0][1], mesh=mesh)
     else:
         s_key, s_payload = sort2(
             jnp.concatenate(keys, axis=0),
             jnp.concatenate([j[1] for j in jobs], axis=0),
+            mesh=mesh,
         )
     iota = jnp.arange(m, dtype=jnp.int32)[None, :]
     outs = []
@@ -344,8 +345,10 @@ def _dup_counts_sorted(sorted_triple) -> Tuple[jax.Array, jax.Array]:
     return dup_elems, dup_bytes
 
 
-def _dup_counts(seg_hash, seg_bytes, seg_valid) -> Tuple[jax.Array, jax.Array]:
-    return _dup_counts_sorted(_sort_runs_many([(seg_hash, seg_bytes, seg_valid)])[0])
+def _dup_counts(seg_hash, seg_bytes, seg_valid, mesh=None) -> Tuple[jax.Array, jax.Array]:
+    return _dup_counts_sorted(
+        _sort_runs_many([(seg_hash, seg_bytes, seg_valid)], mesh=mesh)[0]
+    )
 
 
 def _top_duplicate_sorted(sorted_triple) -> jax.Array:
@@ -440,6 +443,7 @@ def fineweb_stats(
     stop_chars: Sequence[str],
     max_lines: int,
     short_line_length: int,
+    mesh=None,
 ) -> Dict[str, jax.Array]:
     """Integer stats for FineWebQualityFilter (fineweb_quality.rs:71-225)."""
     cps, cls, mask = st.cps, st.cls, st.mask
@@ -468,7 +472,7 @@ def fineweb_stats(
     ends_stop_char = last_nonws & isin_sorted(cps, sc)
     ends_stop = jnp.sum(ends_stop_char, axis=1).astype(jnp.int32)
 
-    dup_elems, dup_bytes = _dup_counts(line_hash_t, line_bytes, line_has_content)
+    dup_elems, dup_bytes = _dup_counts(line_hash_t, line_bytes, line_has_content, mesh)
 
     total_chars_no_nl = jnp.sum(mask & ~li.is_nl, axis=1).astype(jnp.int32)
     newline_count = jnp.sum(li.is_nl, axis=1).astype(jnp.int32)
@@ -500,6 +504,7 @@ def gopher_rep_stats(
     dup_ns: Sequence[int],
     max_segs: int,
     max_words: int,
+    mesh=None,
 ) -> Dict[str, jax.Array]:
     """Integer stats for GopherRepetitionFilter (gopher_rep.rs:52-219)."""
     cps, cls, mask = st.cps, st.cls, st.mask
@@ -547,7 +552,7 @@ def gopher_rep_stats(
 
     lh, lb, lv, n_l = seg_table(l_content, l_start)
     ph, pb, pv, n_p = seg_table(p_content, p_start)
-    l_sorted, p_sorted = _sort_runs_many([(lh, lb, lv), (ph, pb, pv)])
+    l_sorted, p_sorted = _sort_runs_many([(lh, lb, lv), (ph, pb, pv)], mesh=mesh)
     l_dup_elems, l_dup_bytes = _dup_counts_sorted(l_sorted)
     p_dup_elems, p_dup_bytes = _dup_counts_sorted(p_sorted)
 
@@ -613,7 +618,7 @@ def gopher_rep_stats(
             tags.append(("dup", n))
 
     dup_min_flags = None
-    for (kind, n), srt in zip(tags, _sort_runs_many(jobs) if jobs else ()):
+    for (kind, n), srt in zip(tags, _sort_runs_many(jobs, mesh=mesh) if jobs else ()):
         if kind == "top":
             out[f"top_{n}"] = _top_duplicate_sorted(srt)
         else:
@@ -626,7 +631,7 @@ def gopher_rep_stats(
             greedy = [(min_dup, dmf, grams[min_dup][1])]
             if rest:
                 rjobs = [(grams[n][0], idx, grams[n][2]) for n in rest]
-                for n, srt in zip(rest, _sort_runs_many(rjobs)):
+                for n, srt in zip(rest, _sort_runs_many(rjobs, mesh=mesh)):
                     greedy.append(
                         (n, _dup_flags_sorted(srt, grams[n][2], idx), grams[n][1])
                     )
