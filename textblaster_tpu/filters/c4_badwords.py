@@ -85,6 +85,18 @@ class _BadwordsError(Exception):
         self.reason = reason
 
 
+def local_badwords_path(
+    lang: str, cache_base_path: Optional[Path] = None
+) -> Path:
+    """The path ``load_local_badwords`` would read: the cache-dir file if it
+    exists, else the vendored file (which may also not exist)."""
+    cache_dir = (
+        Path(cache_base_path) if cache_base_path else Path("data") / "c4_badwords"
+    )
+    cached = cache_dir / lang
+    return cached if cached.exists() else _VENDORED_DIR / lang
+
+
 def load_local_badwords(
     lang: str, cache_base_path: Optional[Path] = None
 ) -> Optional[list]:
@@ -95,16 +107,13 @@ def load_local_badwords(
     at trace time."""
     if lang not in BADWORDS_LANGS:
         return None
-    cache_dir = (
-        Path(cache_base_path) if cache_base_path else Path("data") / "c4_badwords"
-    )
-    for candidate in (cache_dir / lang, _VENDORED_DIR / lang):
-        if candidate.exists():
-            try:
-                content = candidate.read_text(encoding="utf-8")
-            except OSError:
-                return None
-            return [w.strip() for w in content.splitlines() if w.strip()]
+    candidate = local_badwords_path(lang, cache_base_path)
+    if candidate.exists():
+        try:
+            content = candidate.read_text(encoding="utf-8")
+        except OSError:
+            return None
+        return [w.strip() for w in content.splitlines() if w.strip()]
     return None
 
 

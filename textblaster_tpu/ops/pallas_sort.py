@@ -192,8 +192,18 @@ def _pallas_ok(b: int, m: int) -> bool:
     )
 
 
-def _mesh_size(mesh: Optional[Mesh]) -> int:
-    return 1 if mesh is None else mesh.devices.size
+def _data_axis_size(mesh: Optional[Mesh]) -> Optional[int]:
+    """Size of the ``data`` mesh axis rows are sharded over; 1 only when the
+    whole program is single-device.  None when the mesh has no data axis or
+    has other >1 axes alongside data=1 (shard_map over ``data`` would be
+    ill-formed / a bare pallas call would need a GSPMD rule; callers then
+    use ``lax.sort``, which partitions fine under GSPMD)."""
+    if mesh is None:
+        return 1
+    size = dict(mesh.shape).get(_DATA_AXIS)
+    if size == 1 and mesh.devices.size > 1:
+        return None
+    return size
 
 
 def _sharded_sort(fn, mesh: Mesh, ks):
@@ -225,11 +235,11 @@ def sort3(
     """Lexicographic row sort: Pallas bitonic network on TPU (shard_mapped
     over ``mesh`` when given), ``lax.sort`` elsewhere."""
     b, m = k1.shape
-    n_dev = _mesh_size(mesh)
-    if n_dev > 1:
+    n_dev = _data_axis_size(mesh)
+    if n_dev is not None and n_dev > 1:
         if b % n_dev == 0 and _pallas_ok(b // n_dev, m):
             return _sharded_sort(_dispatch, mesh, (k1, k2, k3))
-    elif _pallas_ok(b, m):
+    elif n_dev == 1 and _pallas_ok(b, m):
         return pallas_sort3(k1, k2, k3, interpret=_interpret_forced())
     return jax.lax.sort(
         (k1.astype(jnp.int32), k2.astype(jnp.int32), k3.astype(jnp.int32)),
@@ -250,11 +260,11 @@ def sort2(
     sorting the full ``(k1, k2)`` pair, which is equivalent up to within-run
     payload order (and exactly equal for iota payloads)."""
     b, m = k1.shape
-    n_dev = _mesh_size(mesh)
-    if n_dev > 1:
+    n_dev = _data_axis_size(mesh)
+    if n_dev is not None and n_dev > 1:
         if b % n_dev == 0 and _pallas_ok(b // n_dev, m):
             return _sharded_sort(_dispatch, mesh, (k1, k2))
-    elif _pallas_ok(b, m):
+    elif n_dev == 1 and _pallas_ok(b, m):
         return pallas_sort2(k1, k2, interpret=_interpret_forced())
     return jax.lax.sort(
         (k1.astype(jnp.int32), k2.astype(jnp.int32)),

@@ -74,7 +74,7 @@ def device_step_types() -> frozenset:
 
 
 @lru_cache(maxsize=64)
-def _badwords_tables_cached(default_language: str, cache_base_path):
+def _badwords_tables_cached(default_language: str, cache_base_path, stat_key):
     from ..filters.c4_badwords import load_local_badwords
     from .badwords import BadwordTables
 
@@ -88,19 +88,44 @@ def _badwords_tables_cached(default_language: str, cache_base_path):
     )
 
 
+def _badwords_list_stat(default_language: str, cache_base_path):
+    """(mtime_ns, size) of the on-disk list, or None when absent — part of
+    the cache key so a list that appears or changes during a long-lived
+    process is observed instead of a stale table (or stale None) sticking
+    for the process lifetime."""
+    import os
+
+    from ..filters.c4_badwords import local_badwords_path
+
+    path = local_badwords_path(default_language, cache_base_path)
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    return (st.st_mtime_ns, st.st_size)
+
+
 def _badwords_tables(step: StepConfig):
     """BadwordTables for the step's default language from local lists only,
-    or None (-> host execution).  Cached per (lang, cache path); the cache
-    also makes the `_step_on_device` check and `_build_fn` see one consistent
-    value even if the on-disk list disappears between them."""
+    or None (-> host execution).  Cached per (lang, cache path, file stat);
+    the cache also makes the `_step_on_device` check and `_build_fn` see one
+    consistent value even if the on-disk list disappears between them."""
     p = step.params
-    return _badwords_tables_cached(p.default_language, p.cache_base_path)
+    stat_key = _badwords_list_stat(p.default_language, p.cache_base_path)
+    return _badwords_tables_cached(p.default_language, p.cache_base_path, stat_key)
 
 
-def _step_on_device(step: StepConfig) -> bool:
+def _step_on_device_base(step: StepConfig) -> bool:
+    """Device eligibility from config alone (no filesystem consulted)."""
     if step.type not in _DEVICE_STEPS:
         return False
     if step.type == "C4QualityFilter" and not step.params.split_paragraph:
+        return False
+    return True
+
+
+def _step_on_device(step: StepConfig) -> bool:
+    if not _step_on_device_base(step):
         return False
     if step.type == "C4BadWordsFilter" and _badwords_tables(step) is None:
         return False
@@ -150,11 +175,20 @@ class CompiledPipeline:
 
         steps = list(config.pipeline)
         n_device = 0
+        # Badwords tables are resolved ONCE here and carried on the instance:
+        # _build_fn may run much later (first batch of a new bucket length),
+        # and the on-disk list can have changed or vanished by then — the
+        # plan must use exactly the tables this placement decision saw.
+        self._badwords_device_tables: Dict[int, object] = {}
         for s in steps:
-            if _step_on_device(s):
-                n_device += 1
-            else:
+            if s.type == "C4BadWordsFilter" and _step_on_device_base(s):
+                tables = _badwords_tables(s)
+                if tables is None:
+                    break
+                self._badwords_device_tables[n_device] = tables
+            elif not _step_on_device(s):
                 break
+            n_device += 1
         self.device_steps = steps[:n_device]
         self.host_steps = steps[n_device:]
         # Host-only fallback when un-kerneled steps precede device steps.
@@ -247,7 +281,7 @@ class CompiledPipeline:
                 )
                 plans.append(("fineweb", i, (stop_chars, p.short_line_length)))
             elif step.type == "C4BadWordsFilter":
-                plans.append(("badwords", i, _badwords_tables(step)))
+                plans.append(("badwords", i, self._badwords_device_tables[i]))
 
         # Mosaic pallas_call has no GSPMD partitioning rule, so multi-device
         # programs run the sort kernels under shard_map over the data axis —

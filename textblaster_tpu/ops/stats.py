@@ -464,6 +464,8 @@ def fineweb_stats(
     line_bytes = _scatter(byte_cnt, li.line_id, lc, max_lines)
     line_has_content = _scatter(has_nonws, li.line_id, lc, max_lines) > 0
     line_hash_t = _scatter(line_hash, li.line_id, lc, max_lines)
+    # Byte-length mixing, as in gopher_rep's tables (collision discrimination).
+    line_hash_t = line_hash_t * jnp.int32(31) + line_bytes
 
     n_nonblank = jnp.sum(line_has_content, axis=1).astype(jnp.int32)
 
@@ -546,6 +548,10 @@ def gopher_rep_stats(
         by = seg_scan_add(jnp.where(content, utf8_width(cps), 0), start)
         tbl_h = _scatter(h, seg_id, end, max_segs)
         tbl_b = _scatter(by, seg_id, end, max_segs)
+        # Mix the byte length into the run key: equal strings keep equal
+        # keys, while hash-colliding unequal strings of different lengths
+        # no longer count as duplicates (ADVICE r2 discrimination note).
+        tbl_h = tbl_h * jnp.int32(31) + tbl_b
         n = jnp.sum(start, axis=1).astype(jnp.int32)
         tbl_valid = jnp.arange(max_segs, dtype=jnp.int32)[None, :] < n[:, None]
         return tbl_h, tbl_b, tbl_valid, n
@@ -586,6 +592,8 @@ def gopher_rep_stats(
         for k in range(n):
             gh = gh * jnp.int32(1000003) + jnp.pad(whash[:, k:], ((0, 0), (0, k)))
             gb = gb + jnp.pad(wbytes[:, k:], ((0, 0), (0, k)))
+        # Byte-length mixing, as for the line/para tables above.
+        gh = gh * jnp.int32(31) + gb
         win_valid = (widx + n) <= n_words[:, None]
         grams[n] = (gh, gb, win_valid)
 
