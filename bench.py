@@ -291,6 +291,9 @@ def main() -> int:
     warmup_s = time.perf_counter() - t0
     _log(f"device warmup (compile) done in {warmup_s:.1f}s")
 
+    from textblaster_tpu.utils.metrics import METRICS
+
+    fallbacks_before = METRICS.get("worker_host_fallback_total")
     run_docs = [d.copy() for d in docs]
     t0 = time.perf_counter()
     dev_outcomes = list(
@@ -320,6 +323,14 @@ def main() -> int:
         "n_docs": len(run_docs),
         "platform": jax.default_backend(),
         "warmup_s": round(warmup_s, 1),
+        # Docs the device path re-ran on the host oracle (outliers / table
+        # overflow).  A high rate means the headline number is partly the
+        # Python path — it must stay near zero for the record to be honest.
+        "host_fallback_frac": round(
+            (METRICS.get("worker_host_fallback_total") - fallbacks_before)
+            / max(len(run_docs), 1),
+            4,
+        ),
     }
     if probe_failures:
         result["probe_failures"] = probe_failures

@@ -1,0 +1,132 @@
+"""End-to-end tests for the kernel-table overflow fallback.
+
+Documents whose line/paragraph/word counts exceed the per-bucket table sizes
+must take the host-oracle rerun path inside ``process_documents_device``
+(ops/pipeline.py assemble_batch) and produce outcomes bit-identical to a pure
+host run — the outlier path SURVEY.md §5 calls for, previously only covered
+at the packing level (VERDICT r2 weak #4).
+"""
+
+import numpy as np
+
+from textblaster_tpu.config.pipeline import parse_pipeline_config
+from textblaster_tpu.data_model import TextDocument
+from textblaster_tpu.ops.pipeline import _table_sizes, process_documents_device
+from textblaster_tpu.orchestration import process_documents_host
+from textblaster_tpu.pipeline_builder import build_pipeline_from_config
+from textblaster_tpu.utils.metrics import METRICS
+
+YAML = """
+pipeline:
+  - type: GopherRepetitionFilter
+    dup_line_frac: 0.5
+    dup_para_frac: 0.5
+    top_n_grams: [[2, 0.5]]
+    dup_n_grams: [[5, 0.5]]
+  - type: GopherQualityFilter
+    min_doc_words: 2
+  - type: C4QualityFilter
+    split_paragraph: true
+    remove_citations: true
+    filter_no_terminal_punct: false
+    min_num_sentences: 1
+    min_words_per_line: 1
+    max_word_length: 1000
+    filter_lorem_ipsum: true
+    filter_javascript: true
+    filter_curly_bracket: true
+    filter_policy: true
+  - type: FineWebQualityFilter
+    line_punct_thr: 0.01
+    line_punct_exclude_zero: false
+    short_line_thr: 0.99
+    short_line_length: 2
+    char_duplicates_ratio: 0.99
+    new_line_ratio: 0.99
+"""
+
+BUCKET = 2048
+MAX_LINES, MAX_WORDS = _table_sizes(BUCKET)
+
+
+def _docs():
+    # line/seg overflow: > MAX_LINES short lines within the bucket.
+    many_lines = "Ja.\n" * (MAX_LINES + 40)
+    # word overflow: > MAX_WORDS one-char words within the bucket.
+    many_words = ("a " * (MAX_WORDS + 90)).strip() + "."
+    normal = (
+        "Det er en god dag, og vi skal ud at gå en tur i skoven. "
+        "Solen skinner over byen i dag."
+    )
+    assert len(many_lines) <= BUCKET - 4
+    assert len(many_words) <= BUCKET - 4
+    return [
+        TextDocument(id="overflow-lines", source="s", content=many_lines),
+        TextDocument(id="normal-1", source="s", content=normal),
+        TextDocument(id="overflow-words", source="s", content=many_words),
+        TextDocument(id="normal-2", source="s", content=normal + " Endnu en."),
+    ]
+
+
+def test_overflow_docs_fall_back_and_match_host_exactly():
+    config = parse_pipeline_config(YAML)
+    assert any(len(d.content.splitlines()) > MAX_LINES for d in _docs())
+    assert any(len(d.content.split()) > MAX_WORDS for d in _docs())
+
+    before = METRICS.get("worker_host_fallback_total")
+    dev = {
+        o.document.id: o
+        for o in process_documents_device(
+            config, iter(_docs()), device_batch=8, buckets=(BUCKET,)
+        )
+    }
+    fallbacks = METRICS.get("worker_host_fallback_total") - before
+    host = {
+        o.document.id: o
+        for o in process_documents_host(
+            build_pipeline_from_config(config), iter(_docs())
+        )
+    }
+
+    assert set(dev) == set(host) == {
+        "overflow-lines", "normal-1", "overflow-words", "normal-2"
+    }
+    for k in host:
+        assert dev[k].kind == host[k].kind, k
+        assert dev[k].reason == host[k].reason, k
+        assert dev[k].document.content == host[k].document.content, k
+        assert dev[k].document.metadata == host[k].document.metadata, k
+    # Both overflow docs took the counted host rerun.
+    assert fallbacks >= 2
+
+
+def test_over_length_docs_fall_back_via_packer():
+    """Docs longer than the largest bucket never reach the device at all."""
+    config = parse_pipeline_config(YAML)
+    huge = "Det er en god dag, og vi er her. " * 200  # > 2048 chars
+    docs = [
+        TextDocument(id="huge", source="s", content=huge),
+        TextDocument(id="small", source="s", content="Det er en god dag her."),
+    ]
+    before = METRICS.get("worker_host_fallback_total")
+    dev = {
+        o.document.id: o
+        for o in process_documents_device(
+            config, iter(docs), device_batch=8, buckets=(BUCKET,)
+        )
+    }
+    assert METRICS.get("worker_host_fallback_total") - before >= 1
+    host = {
+        o.document.id: o
+        for o in process_documents_host(
+            build_pipeline_from_config(config),
+            iter([
+                TextDocument(id="huge", source="s", content=huge),
+                TextDocument(id="small", source="s", content="Det er en god dag her."),
+            ]),
+        )
+    }
+    for k in host:
+        assert dev[k].kind == host[k].kind, k
+        assert dev[k].reason == host[k].reason, k
+        assert dev[k].document.metadata == host[k].document.metadata, k

@@ -107,6 +107,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
         return 1
 
     start = time.perf_counter()
+    fallbacks_before = METRICS.get("worker_host_fallback_total")
 
     try:
         if args.checkpoint_dir:
@@ -158,6 +159,17 @@ def _cmd_run(args: argparse.Namespace) -> int:
         f"{result.filtered} excluded -> {args.excluded_file}, "
         f"{result.errors} errored (in neither file)."
     )
+    fallbacks = int(
+        METRICS.get("worker_host_fallback_total") - fallbacks_before
+    )
+    if fallbacks:
+        # Outlier documents (over-length / table overflow) re-ran the host
+        # oracle — bit-exact outcomes, but worth surfacing: a high rate means
+        # the device path is not carrying the load it appears to.
+        print(
+            f"Host-fallback documents: {fallbacks} "
+            f"({fallbacks / max(total, 1):.1%} of stream)."
+        )
     if result.read_errors:
         print(f"Warning: {result.read_errors} rows could not be read.",
               file=sys.stderr)

@@ -138,23 +138,21 @@ class C4BadWordsFilter(ProcessingStep):
                 )
             return None
 
-        cache_dir = (
-            Path(self.params.cache_base_path)
-            if self.params.cache_base_path
-            else Path("data") / "c4_badwords"
-        )
-        cache_file = cache_dir / lang
-        vendored_file = _VENDORED_DIR / lang
-
-        if cache_file.exists():
+        # Same resolution as the device-table builder (local_badwords_path):
+        # cache file first, vendored copy second, download last.
+        source = local_badwords_path(lang, self.params.cache_base_path)
+        if source.exists():
             try:
-                words_content = cache_file.read_text(encoding="utf-8")
+                words_content = source.read_text(encoding="utf-8")
             except OSError as e:
                 raise _BadwordsError(f"I/O error: {e}") from e
-        elif vendored_file.exists():
-            words_content = vendored_file.read_text(encoding="utf-8")
         else:
-            words_content = self._download(lang, cache_dir, cache_file)
+            cache_dir = (
+                Path(self.params.cache_base_path)
+                if self.params.cache_base_path
+                else Path("data") / "c4_badwords"
+            )
+            words_content = self._download(lang, cache_dir, cache_dir / lang)
 
         badwords = [w.strip() for w in words_content.splitlines()]
         badwords = [w for w in badwords if w]
