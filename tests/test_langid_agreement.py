@@ -1,0 +1,80 @@
+"""Measured decision agreement of the language-ID model on a labeled corpus.
+
+The reference delegates language ID to lingua over the candidate set
+{English, Danish, Swedish, Nynorsk, Bokmål}
+(``/root/reference/src/pipeline/filters/language_filter.rs:39-46``); lingua
+is not available in this environment, so agreement with it cannot be measured
+directly.  The executable proxy is accuracy on a labeled out-of-sample
+corpus: 250 original sentences (50 per language, news/everyday/practical
+registers) in ``tests/data/langid_corpus.tsv``, disjoint from the model's
+training text (``textblaster_tpu/models/langid_data.py``).
+
+Measured at round 3 (recorded so regressions are loud):
+
+* overall accuracy:              0.924  (231/250)
+* accuracy on confident (>=0.65) 0.923  at 0.99 coverage
+* English and Swedish:           >= 0.96 each
+* residual confusions concentrate in Bokmål->Danish and Nynorsk<->Bokmål —
+  the orthographically near-identical pairs, which are also lingua's
+  documented hard cases for short text.
+
+The floors asserted here are a step below the measured values to allow for
+benign retraining noise; genuine regressions (e.g. profile-table breakage)
+land far below them.
+"""
+
+from collections import Counter, defaultdict
+from pathlib import Path
+
+from textblaster_tpu.models.langid import LangIdModel, NAME_TO_ISO
+
+CORPUS = Path(__file__).parent / "data" / "langid_corpus.tsv"
+
+
+def _rows():
+    for line in CORPUS.read_text(encoding="utf-8").splitlines():
+        if line.strip():
+            lang, text = line.split("\t", 1)
+            yield lang, text
+
+
+def test_corpus_shape():
+    counts = Counter(lang for lang, _ in _rows())
+    assert set(counts) == {"eng", "dan", "swe", "nno", "nob"}
+    assert all(n == 50 for n in counts.values()), counts
+
+
+def test_labeled_corpus_agreement():
+    model = LangIdModel()
+    total = correct = conf_total = conf_correct = 0
+    by_lang = defaultdict(lambda: [0, 0])
+    for lang, text in _rows():
+        detected = model.detect(text)
+        assert detected is not None, text
+        name, conf = detected
+        iso = NAME_TO_ISO[name]
+        ok = iso == lang
+        total += 1
+        correct += ok
+        by_lang[lang][0] += ok
+        by_lang[lang][1] += 1
+        if conf >= 0.65:  # the shipped config's min_confidence
+            conf_total += 1
+            conf_correct += ok
+
+    overall = correct / total
+    confident = conf_correct / max(conf_total, 1)
+    coverage = conf_total / total
+    assert overall >= 0.88, f"overall accuracy regressed: {overall:.3f}"
+    assert confident >= 0.88, f"confident accuracy regressed: {confident:.3f}"
+    assert coverage >= 0.90, f"confidence coverage collapsed: {coverage:.3f}"
+    # The easy/distant languages must stay near-perfect.
+    for lang in ("eng", "swe", "dan"):
+        acc = by_lang[lang][0] / by_lang[lang][1]
+        assert acc >= 0.90, f"{lang}: {acc:.3f}"
+
+
+def test_short_fragments_stay_uncertain():
+    model = LangIdModel()
+    _, conf = model.detect("ja")
+    assert conf < 0.65

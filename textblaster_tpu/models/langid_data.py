@@ -1,0 +1,356 @@
+"""Training text for the language-ID trigram profiles.
+
+The reference uses lingua's shipped statistical models
+(``/root/reference/src/pipeline/filters/language_filter.rs:39-46``); those
+tables cannot be vendored here, so the framework trains its own profiles from
+this module: original prose authored for this project in each candidate
+language (everyday/news/nature/practical registers), chosen to exercise the
+orthography that separates the close Scandinavian pairs — Danish 'af/øj/ej/
+-tion', Bokmål 'av/øy/ei/-sjon', Nynorsk 'ikkje/kva/ein/-inga', Swedish
+'och/ä/inte/-ning'.
+
+Kept deliberately disjoint from the labeled evaluation fixture
+(``tests/data/langid_corpus.tsv``) so the agreement number measured there is
+out-of-sample.
+"""
+
+_TRAIN_TEXT_1 = {
+    "English": """
+The kitchen smelled of fresh bread when the children came home from school.
+In autumn the forest turns red and gold, and the air grows cold at night.
+A small boat crossed the bay while the sun set behind the islands.
+The engineers checked every bolt on the bridge before it opened to traffic.
+Most shops close early on Sundays, so people buy their groceries on Saturday.
+He has worked as a carpenter for thirty years and still enjoys the craft.
+The weather forecast promises sunshine tomorrow with a light breeze from the west.
+She borrowed three books from the library and read them all in one week.
+Onions should be fried slowly in butter until they turn soft and golden.
+The city council plans to build a new swimming pool next to the school.
+Trains run every ten minutes during the day and every half hour at night.
+Their grandmother grew roses and tomatoes in the little garden behind the house.
+The meeting lasted two hours, but no decision was reached in the end.
+Fishermen set out before dawn when the sea was calm and quiet.
+The new phone costs far too much, so I will keep my old one.
+Snow fell all night, and by morning the roads were white and silent.
+A good night's sleep matters more for your health than most people think.
+They painted the fence green and planted flowers along the narrow path.
+The teacher asked the pupils to write a short story about the summer.
+Prices went up again this month, mainly because fuel became more expensive.
+The concert hall was full, and the audience clapped for several minutes.
+He missed the last bus and had to walk the whole way home in the rain.
+Wash the vegetables carefully and cut them into thin slices before serving.
+The old clock on the wall has not worked since last winter.
+Tourists come here in summer to hike in the mountains and swim in the lakes.
+The newspaper wrote about a farmer who found a silver coin in his field.
+Every spring the birds return and build their nests under the roof.
+The doctor told him to rest for a week and drink plenty of water.
+Our neighbours moved to the countryside because the city became too loud.
+The factory employs two hundred people and exports machines to many countries.
+She plays the violin in the evenings, and the music drifts across the yard.
+Remember to lock the door and turn off the lights before you leave.
+The ferry was cancelled because of the storm, so we stayed another night.
+His speech was short but honest, and people liked it very much.
+A cat sat on the windowsill watching the rain run down the glass.
+The bakery opens at six, and the smell of bread fills the whole street.
+They have been friends since childhood and still meet every Friday.
+The museum keeps old tools, photographs and letters from the fishing villages.
+Water boils faster with a lid on the pot, which saves energy.
+The referee stopped the match twice because the fog grew too thick.
+""",
+    "Danish": """
+Køkkenet duftede af friskbagt brød, da børnene kom hjem fra skole.
+Om efteråret bliver skoven rød og gylden, og luften er kold om natten.
+En lille båd sejlede over bugten, mens solen gik ned bag øerne.
+Ingeniørerne efterså hver eneste bolt på broen, før den blev åbnet for trafik.
+De fleste butikker lukker tidligt om søndagen, så folk handler ind om lørdagen.
+Han har arbejdet som tømrer i tredive år og holder stadig af sit håndværk.
+Vejrudsigten lover solskin i morgen med en let vind fra vest.
+Hun lånte tre bøger på biblioteket og læste dem alle på en uge.
+Løg skal steges langsomt i smør, til de bliver bløde og gyldne.
+Kommunen planlægger at bygge en ny svømmehal ved siden af skolen.
+Togene kører hvert tiende minut om dagen og hver halve time om natten.
+Deres bedstemor dyrkede roser og tomater i den lille have bag huset.
+Mødet varede to timer, men der blev ikke truffet nogen beslutning til sidst.
+Fiskerne tog af sted før daggry, mens havet var roligt og stille.
+Den nye telefon koster alt for meget, så jeg beholder min gamle.
+Sneen faldt hele natten, og om morgenen lå vejene hvide og tavse.
+En god nats søvn betyder mere for helbredet, end de fleste tror.
+De malede hegnet grønt og plantede blomster langs den smalle sti.
+Læreren bad eleverne skrive en lille historie om sommeren.
+Priserne steg igen i denne måned, især fordi brændstof blev dyrere.
+Koncertsalen var fyldt, og publikum klappede i flere minutter.
+Han nåede ikke den sidste bus og måtte gå hele vejen hjem i regnen.
+Skyl grøntsagerne omhyggeligt, og skær dem i tynde skiver før servering.
+Det gamle ur på væggen har ikke virket siden sidste vinter.
+Turister kommer hertil om sommeren for at vandre i bjergene og bade i søerne.
+Avisen skrev om en landmand, der fandt en sølvmønt på sin mark.
+Hvert forår vender fuglene tilbage og bygger rede under taget.
+Lægen sagde, at han skulle hvile sig en uge og drikke rigeligt med vand.
+Vores naboer flyttede på landet, fordi byen blev for larmende.
+Fabrikken beskæftiger to hundrede mennesker og eksporterer maskiner til mange lande.
+Hun spiller violin om aftenen, og musikken driver hen over gården.
+Husk at låse døren og slukke lyset, inden du går.
+Færgen blev aflyst på grund af stormen, så vi blev der en nat mere.
+Hans tale var kort, men ærlig, og folk kunne rigtig godt lide den.
+En kat sad i vindueskarmen og så regnen løbe ned ad ruden.
+Bageriet åbner klokken seks, og duften af brød fylder hele gaden.
+De har været venner siden barndommen og mødes stadig hver fredag.
+Museet opbevarer gammelt værktøj, fotografier og breve fra fiskerlejerne.
+Vandet koger hurtigere med låg på gryden, og det sparer energi.
+Dommeren afbrød kampen to gange, fordi tågen blev for tæt.
+Informationen findes på stationen, og billetter kan købes i automaten.
+Situationen i organisationen krævede en hurtig løsning af bestyrelsen.
+""",
+    "Swedish": """
+Köket doftade av nybakat bröd när barnen kom hem från skolan.
+På hösten blir skogen röd och gyllene, och luften är kall om natten.
+En liten båt seglade över viken medan solen gick ner bakom öarna.
+Ingenjörerna kontrollerade varje bult på bron innan den öppnades för trafik.
+De flesta affärer stänger tidigt på söndagar, så folk handlar på lördagen.
+Han har arbetat som snickare i trettio år och tycker fortfarande om sitt hantverk.
+Väderprognosen lovar solsken i morgon med en svag vind från väster.
+Hon lånade tre böcker på biblioteket och läste alla på en vecka.
+Lök ska stekas långsamt i smör tills den blir mjuk och gyllene.
+Kommunen planerar att bygga en ny simhall bredvid skolan.
+Tågen går var tionde minut på dagen och varje halvtimme på natten.
+Deras mormor odlade rosor och tomater i den lilla trädgården bakom huset.
+Mötet pågick i två timmar, men inget beslut fattades till slut.
+Fiskarna gav sig av före gryningen medan havet låg lugnt och stilla.
+Den nya telefonen kostar alldeles för mycket, så jag behåller min gamla.
+Snön föll hela natten, och på morgonen låg vägarna vita och tysta.
+En god natts sömn betyder mer för hälsan än de flesta tror.
+De målade staketet grönt och planterade blommor längs den smala stigen.
+Läraren bad eleverna skriva en kort berättelse om sommaren.
+Priserna steg igen den här månaden, främst för att bränslet blev dyrare.
+Konsertsalen var fullsatt, och publiken applåderade i flera minuter.
+Han missade sista bussen och fick gå hela vägen hem i regnet.
+Skölj grönsakerna noggrant och skär dem i tunna skivor före servering.
+Den gamla klockan på väggen har inte fungerat sedan i vintras.
+Turister kommer hit på sommaren för att vandra i fjällen och bada i sjöarna.
+Tidningen skrev om en bonde som hittade ett silvermynt på sin åker.
+Varje vår kommer fåglarna tillbaka och bygger bo under taket.
+Läkaren sade åt honom att vila en vecka och dricka mycket vatten.
+Våra grannar flyttade ut på landet eftersom staden blev för högljudd.
+Fabriken sysselsätter tvåhundra personer och exporterar maskiner till många länder.
+Hon spelar fiol på kvällarna, och musiken svävar över gården.
+Kom ihåg att låsa dörren och släcka lamporna innan du går.
+Färjan ställdes in på grund av stormen, så vi stannade en natt till.
+Hans tal var kort men ärligt, och folk tyckte mycket om det.
+En katt satt i fönstret och tittade på regnet som rann nerför rutan.
+Bageriet öppnar klockan sex, och doften av bröd fyller hela gatan.
+De har varit vänner sedan barndomen och träffas fortfarande varje fredag.
+Museet bevarar gamla verktyg, fotografier och brev från fiskelägena.
+Vattnet kokar snabbare med lock på kastrullen, vilket sparar energi.
+Domaren avbröt matchen två gånger eftersom dimman blev för tät.
+Människor säger att det är något särskilt med ljuset här uppe.
+""",
+    "Bokmal": """
+Kjøkkenet luktet nybakt brød da barna kom hjem fra skolen.
+Om høsten blir skogen rød og gyllen, og lufta er kald om natta.
+En liten båt seilte over bukta mens sola gikk ned bak øyene.
+Ingeniørene sjekket hver eneste bolt på brua før den ble åpnet for trafikk.
+De fleste butikkene stenger tidlig på søndager, så folk handler på lørdagen.
+Han har jobbet som snekker i tretti år og liker fortsatt håndverket sitt.
+Værmeldingen lover solskinn i morgen med en lett bris fra vest.
+Hun lånte tre bøker på biblioteket og leste alle sammen på en uke.
+Løk skal stekes sakte i smør til den blir myk og gyllen.
+Kommunen planlegger å bygge en ny svømmehall ved siden av skolen.
+Togene går hvert tiende minutt om dagen og hver halvtime om natta.
+Bestemoren deres dyrket roser og tomater i den lille hagen bak huset.
+Møtet varte i to timer, men ingen beslutning ble tatt til slutt.
+Fiskerne dro ut før daggry mens sjøen lå rolig og stille.
+Den nye telefonen koster altfor mye, så jeg beholder den gamle.
+Snøen falt hele natta, og om morgenen lå veiene hvite og stille.
+En god natts søvn betyr mer for helsa enn folk flest tror.
+De malte gjerdet grønt og plantet blomster langs den smale stien.
+Læreren ba elevene skrive en kort fortelling om sommeren.
+Prisene steg igjen denne måneden, først og fremst fordi drivstoffet ble dyrere.
+Konsertsalen var fullsatt, og publikum klappet i flere minutter.
+Han rakk ikke den siste bussen og måtte gå hele veien hjem i regnet.
+Skyll grønnsakene nøye og skjær dem i tynne skiver før servering.
+Den gamle klokka på veggen har ikke virket siden i fjor vinter.
+Turister kommer hit om sommeren for å gå i fjellet og bade i vannene.
+Avisen skrev om en bonde som fant en sølvmynt på jordet sitt.
+Hver vår kommer fuglene tilbake og bygger reir under taket.
+Legen sa at han skulle hvile en uke og drikke rikelig med vann.
+Naboene våre flyttet ut på landet fordi byen ble for bråkete.
+Fabrikken sysselsetter to hundre mennesker og eksporterer maskiner til mange land.
+Hun spiller fiolin om kveldene, og musikken driver ut over gårdsplassen.
+Husk å låse døra og slukke lysene før du går.
+Ferga ble innstilt på grunn av uværet, så vi ble der en natt til.
+Talen hans var kort, men ærlig, og folk likte den svært godt.
+En katt satt i vinduskarmen og så på regnet som rant nedover ruta.
+Bakeriet åpner klokka seks, og lukten av brød fyller hele gata.
+De har vært venner siden barndommen og møtes fremdeles hver fredag.
+Museet tar vare på gammelt verktøy, fotografier og brev fra fiskeværene.
+Vannet koker raskere med lokk på kjelen, og det sparer energi.
+Dommeren stanset kampen to ganger fordi tåka ble for tett.
+Informasjonen finnes på stasjonen, og billetter kjøpes i automaten.
+Situasjonen i organisasjonen krevde en rask løsning fra styret.
+""",
+    "Nynorsk": """
+Kjøkenet lukta nybaka brød då borna kom heim frå skulen.
+Om hausten blir skogen raud og gyllen, og lufta er kald om natta.
+Ein liten båt segla over bukta medan sola gjekk ned bak øyane.
+Ingeniørane sjekka kvar einaste bolt på brua før ho vart opna for trafikk.
+Dei fleste butikkane stengjer tidleg på søndagar, så folk handlar på laurdagen.
+Han har arbeidd som snikkar i tretti år og likar framleis handverket sitt.
+Vêrmeldinga lovar solskin i morgon med ein lett bris frå vest.
+Ho lånte tre bøker på biblioteket og las alle saman på ei veke.
+Lauk skal steikjast sakte i smør til han blir mjuk og gyllen.
+Kommunen planlegg å byggje ein ny symjehall ved sida av skulen.
+Toga går kvart tiande minutt om dagen og kvar halvtime om natta.
+Bestemora deira dyrka roser og tomatar i den vesle hagen bak huset.
+Møtet varte i to timar, men inga avgjerd vart teken til slutt.
+Fiskarane drog ut før daggry medan sjøen låg roleg og stille.
+Den nye telefonen kostar altfor mykje, så eg held på den gamle.
+Snøen fall heile natta, og om morgonen låg vegane kvite og stille.
+Ein god natts svevn tyder meir for helsa enn folk flest trur.
+Dei måla gjerdet grønt og planta blomar langs den smale stigen.
+Læraren bad elevane skrive ei kort forteljing om sommaren.
+Prisane steig igjen denne månaden, først og fremst fordi drivstoffet vart dyrare.
+Konsertsalen var fullsett, og publikum klappa i fleire minutt.
+Han rakk ikkje den siste bussen og måtte gå heile vegen heim i regnet.
+Skyl grønsakene nøye og skjer dei i tynne skiver før servering.
+Den gamle klokka på veggen har ikkje verka sidan i fjor vinter.
+Turistar kjem hit om sommaren for å gå i fjellet og bade i vatna.
+Avisa skreiv om ein bonde som fann ein sølvmynt på jordet sitt.
+Kvar vår kjem fuglane tilbake og byggjer reir under taket.
+Legen sa at han skulle kvile ei veke og drikke rikeleg med vatn.
+Naboane våre flytta ut på landet fordi byen vart for bråkete.
+Fabrikken sysselset to hundre menneske og eksporterer maskinar til mange land.
+Ho spelar fele om kveldane, og musikken driv ut over tunet.
+Hugs å låse døra og sløkkje lysa før du går.
+Ferja vart innstilt på grunn av uvêret, så vi vart verande ei natt til.
+Talen hans var kort, men ærleg, og folk likte han svært godt.
+Ein katt sat i glaskarmen og såg på regnet som rann nedover ruta.
+Bakeriet opnar klokka seks, og lukta av brød fyller heile gata.
+Dei har vore vener sidan barndomen og møtest framleis kvar fredag.
+Museet tek vare på gamalt verktøy, fotografi og brev frå fiskeværa.
+Vatnet kokar raskare med lok på kjelen, og det sparer energi.
+Dommaren stansa kampen to gonger fordi skodda vart for tett.
+Informasjonen finst på stasjonen, og billettar kan kjøpast i automaten.
+Situasjonen i organisasjonen kravde ei rask løysing frå styret.
+""",
+}
+
+# Second block: near-parallel everyday/administrative prose.  Parallel
+# content across the candidate languages concentrates the learned differences
+# on orthography and function words — exactly the evidence that separates the
+# close pairs.
+_TRAIN_TEXT_2 = {
+    "English": """
+After work she usually takes the tram home and makes dinner for the family.
+The report shows that unemployment fell slightly during the last quarter.
+If you want to apply for the position, you must send your application before Friday.
+The road over the mountain is closed in winter because of snow and strong winds.
+He bought a used car last year, and it has worked perfectly ever since.
+The school arranges a trip to the capital for all pupils in the eighth grade.
+We have to change trains twice before we reach the little town by the border.
+The doctor examined the boy's knee and said that nothing was broken.
+It is cheaper to travel in September, when the summer season is over.
+The municipality has decided to renovate the swimming hall next year.
+Many young people move to the big cities to study or to find work.
+Could you please close the window? It is getting cold in here.
+The book lay open on the table when the police entered the apartment.
+They celebrated their fiftieth wedding anniversary with the whole family.
+The bus stops right outside the hospital's main entrance every ten minutes.
+In the evening the temperature drops quickly, so bring a warm sweater.
+The insurance covers damage caused by fire, water and burglary.
+He answered all the questions calmly and explained what had happened that night.
+The bakery sells fresh rolls from early morning until late afternoon.
+Several roads were flooded after the heavy rainfall on Tuesday.
+""",
+    "Danish": """
+Efter arbejde tager hun som regel sporvognen hjem og laver aftensmad til familien.
+Rapporten viser, at arbejdsløsheden faldt en smule i det seneste kvartal.
+Hvis du vil søge stillingen, skal du sende din ansøgning inden fredag.
+Vejen over fjeldet er lukket om vinteren på grund af sne og kraftig blæst.
+Han købte en brugt bil sidste år, og den har kørt upåklageligt lige siden.
+Skolen arrangerer en tur til hovedstaden for alle elever i ottende klasse.
+Vi skal skifte tog to gange, før vi når den lille by ved grænsen.
+Lægen undersøgte drengens knæ og sagde, at intet var brækket.
+Det er billigere at rejse i september, når sommersæsonen er forbi.
+Kommunen har besluttet at renovere svømmehallen til næste år.
+Mange unge flytter til de store byer for at studere eller finde arbejde.
+Vil du ikke lukke vinduet? Det begynder at blive koldt herinde.
+Bogen lå opslået på bordet, da politiet trådte ind i lejligheden.
+De fejrede deres guldbryllup sammen med hele familien.
+Bussen stopper lige uden for hospitalets hovedindgang hvert tiende minut.
+Om aftenen falder temperaturen hurtigt, så tag en varm trøje med.
+Forsikringen dækker skader forårsaget af brand, vand og indbrud.
+Han besvarede alle spørgsmålene roligt og forklarede, hvad der var sket den nat.
+Bageren sælger friske rundstykker fra tidlig morgen til sen eftermiddag.
+Flere veje blev oversvømmet efter det kraftige regnvejr tirsdag.
+""",
+    "Swedish": """
+Efter jobbet tar hon oftast spårvagnen hem och lagar middag åt familjen.
+Rapporten visar att arbetslösheten sjönk något under det senaste kvartalet.
+Om du vill söka tjänsten måste du skicka in din ansökan före fredag.
+Vägen över fjället är stängd på vintern på grund av snö och hårda vindar.
+Han köpte en begagnad bil i fjol, och den har fungerat felfritt sedan dess.
+Skolan ordnar en resa till huvudstaden för alla elever i åttonde klass.
+Vi måste byta tåg två gånger innan vi når den lilla staden vid gränsen.
+Läkaren undersökte pojkens knä och sade att ingenting var brutet.
+Det är billigare att resa i september när sommarsäsongen är över.
+Kommunen har beslutat att renovera simhallen nästa år.
+Många unga flyttar till storstäderna för att plugga eller hitta jobb.
+Kan du vara snäll och stänga fönstret? Det börjar bli kallt här inne.
+Boken låg uppslagen på bordet när polisen steg in i lägenheten.
+De firade sin guldbröllopsdag tillsammans med hela familjen.
+Bussen stannar precis utanför sjukhusets huvudentré var tionde minut.
+På kvällen sjunker temperaturen snabbt, så ta med en varm tröja.
+Försäkringen täcker skador orsakade av brand, vatten och inbrott.
+Han besvarade alla frågor lugnt och förklarade vad som hade hänt den natten.
+Bageriet säljer färska frallor från tidig morgon till sen eftermiddag.
+Flera vägar översvämmades efter det kraftiga regnet i tisdags.
+""",
+    "Bokmal": """
+Etter jobb tar hun som regel trikken hjem og lager middag til familien.
+Rapporten viser at arbeidsledigheten sank noe i det siste kvartalet.
+Hvis du vil søke på stillingen, må du sende søknaden din innen fredag.
+Veien over fjellet er stengt om vinteren på grunn av snø og sterk vind.
+Han kjøpte en bruktbil i fjor, og den har virket helt fint siden.
+Skolen arrangerer en tur til hovedstaden for alle elevene på åttende trinn.
+Vi må bytte tog to ganger før vi når den lille byen ved grensen.
+Legen undersøkte kneet til gutten og sa at ingenting var brukket.
+Det er billigere å reise i september, når sommersesongen er over.
+Kommunen har bestemt seg for å pusse opp svømmehallen neste år.
+Mange unge flytter til de store byene for å studere eller finne seg jobb.
+Kan du være så snill å lukke vinduet? Det begynner å bli kaldt her inne.
+Boka lå oppslått på bordet da politiet kom inn i leiligheten.
+De feiret gullbryllupet sitt sammen med hele familien.
+Bussen stopper rett utenfor hovedinngangen til sykehuset hvert tiende minutt.
+Om kvelden synker temperaturen raskt, så ta med deg en varm genser.
+Forsikringen dekker skader forårsaket av brann, vann og innbrudd.
+Han svarte rolig på alle spørsmålene og forklarte hva som hadde skjedd den natten.
+Bakeren selger ferske rundstykker fra tidlig morgen til sein ettermiddag.
+Flere veier ble oversvømt etter det kraftige regnværet tirsdag.
+""",
+    "Nynorsk": """
+Etter arbeid tek ho som regel trikken heim og lagar middag til familien.
+Rapporten viser at arbeidsløysa sokk noko i det siste kvartalet.
+Dersom du vil søkje på stillinga, må du sende søknaden din innan fredag.
+Vegen over fjellet er stengd om vinteren på grunn av snø og sterk vind.
+Han kjøpte ein bruktbil i fjor, og han har verka heilt fint sidan.
+Skulen arrangerer ein tur til hovudstaden for alle elevane på åttande steget.
+Vi må byte tog to gonger før vi når den vesle byen ved grensa.
+Legen undersøkte kneet til guten og sa at ingenting var brote.
+Det er billegare å reise i september, når sommarsesongen er over.
+Kommunen har bestemt seg for å pusse opp symjehallen neste år.
+Mange unge flyttar til dei store byane for å studere eller finne seg arbeid.
+Kan du vere så snill å late att vindauget? Det byrjar å bli kaldt her inne.
+Boka låg oppslått på bordet då politiet kom inn i leilegheita.
+Dei feira gullbryllaupet sitt saman med heile familien.
+Bussen stoppar rett utanfor hovudinngangen til sjukehuset kvart tiande minutt.
+Om kvelden søkk temperaturen raskt, så ta med deg ein varm genser.
+Forsikringa dekkjer skadar som kjem av brann, vatn og innbrot.
+Han svara roleg på alle spørsmåla og forklarte kva som hadde hendt den natta.
+Bakaren sel ferske rundstykke frå tidleg morgon til sein ettermiddag.
+Fleire vegar vart oversvømde etter det kraftige regnvêret tysdag.
+""",
+}
+
+TRAIN_TEXT = {
+    lang: _TRAIN_TEXT_1[lang] + _TRAIN_TEXT_2[lang] for lang in _TRAIN_TEXT_1
+}
