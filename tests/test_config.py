@@ -280,3 +280,29 @@ class TestTokenCounterValidation:
             'pipeline:\n  - type: TokenCounter\n    tokenizer_name: ""\n',
             "tokenizer_name cannot be empty",
         )
+
+
+def test_shipped_config_matches_reference_step_list():
+    """The shipped pipeline ends with TokenCounter(gpt2) exactly like the
+    reference's config/pipeline_config.yaml; the offline variant is identical
+    minus that step (tokenizer data needs a local file, hub cache, or
+    network)."""
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    full = load_pipeline_config(os.path.join(root, "configs/pipeline_config.yaml"))
+    off = load_pipeline_config(
+        os.path.join(root, "configs/pipeline_config_offline.yaml")
+    )
+    full_types = [s.type for s in full.pipeline]
+    assert full_types == [
+        "LanguageDetectionFilter",
+        "GopherRepetitionFilter",
+        "GopherQualityFilter",
+        "C4QualityFilter",
+        "FineWebQualityFilter",
+        "TokenCounter",
+    ]
+    assert full.pipeline[-1].params.tokenizer_name == "gpt2"
+    # Identical params, not just step types — the offline copy must not drift.
+    assert off.pipeline == full.pipeline[:-1]

@@ -18,10 +18,13 @@ preserved; the renegotiation SURVEY.md §7 anticipates).  With ``seed`` unset
 the draw falls back to an unseeded shared stream, nondeterministic like the
 reference's default.
 
-Network note: the reference downloads lists over HTTP at first use
-(c4_filters.rs:354-412).  This build ships vendored LDNOOBW lists for the
-common languages under ``textblaster_tpu/data/c4_badwords/`` and only falls
-back to HTTP when a list is neither vendored nor cached.
+Network note: the reference downloads lists over HTTP at first use and vendors
+none (c4_filters.rs:354-412) — offline, it supports zero of the 28 languages.
+This build ships vendored LDNOOBW lists for ``da`` and ``en`` (authored-list
+redistribution for the remaining 26 is neither possible offline nor required
+for parity: the same lazy download + on-disk cache covers them exactly as the
+reference's does, and ``cache_base_path`` lets deployments pre-seed every
+language from a mirror).
 """
 
 from __future__ import annotations

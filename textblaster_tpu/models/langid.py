@@ -128,8 +128,10 @@ def _hash3(c1: int, c2: int, c3: int) -> int:
 
 def _hash3_vec(arr: "np.ndarray") -> "np.ndarray":
     """Vectorized :func:`_hash3` over a codepoint sequence ``[n] -> [n-2]``.
-    The single place the sliding-window form lives — training and scoring
-    must hash identically or the table silently mistrains."""
+    Training and scoring must hash identically or the table silently
+    mistrains.  The device kernel carries its own jnp twin of this formula
+    (:mod:`textblaster_tpu.ops.langid_tpu`, ``langid_scores``) — change all
+    three together, and the host/device parity suite will catch a miss."""
     return (arr[:-2] * 961 + arr[1:-1] * 31 + arr[2:]) & (TABLE_SIZE - 1)
 
 
