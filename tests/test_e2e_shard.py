@@ -1,0 +1,271 @@
+"""Scale-level E2E: a deterministic 10k-doc shard through three backends.
+
+SURVEY.md §4 analog (c): the reference's full-pipeline integration test runs
+compiled binaries against a containerized broker; the equivalent here is one
+10k-document shard — mixed lengths, languages, dup patterns, overflow
+outliers, unicode — asserted to produce **identical kept/excluded id sets,
+reasons, and rewritten content** across
+
+1. the pure host oracle (the reference-semantics path),
+2. the compiled device pipeline on a single device, and
+3. the compiled pipeline sharded over the virtual 8-device CPU mesh,
+
+plus a CLI-level pass (Parquet in -> kept/excluded Parquet out) over the same
+shard exercising the reader/writer/aggregation layers.
+
+The corpus is generated, not vendored: a seeded PCG64 stream is
+platform-deterministic, and ``test_corpus_fingerprint`` pins a content hash
+so any silent generator drift fails loudly (a 10k-doc Parquet binary in git
+would say less and cost megabytes).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from textblaster_tpu.config.pipeline import parse_pipeline_config
+from textblaster_tpu.data_model import TextDocument
+from textblaster_tpu.ops.pipeline import process_documents_device
+from textblaster_tpu.orchestration import process_documents_host
+from textblaster_tpu.pipeline_builder import build_pipeline_from_config
+
+N_DOCS = 10_000
+SEED = 31_337
+BUCKETS = (512, 2048, 8192)
+
+# The shipped Danish pipeline minus TokenCounter (needs tokenizer data).
+YAML = """
+pipeline:
+  - type: LanguageDetectionFilter
+    min_confidence: 0.65
+    allowed_languages: [ "dan" ]
+  - type: GopherRepetitionFilter
+    dup_line_frac: 0.3
+    dup_para_frac: 0.3
+    dup_line_char_frac: 0.2
+    dup_para_char_frac: 0.2
+    top_n_grams: [[2, 0.2], [3, 0.18], [4, 0.16]]
+    dup_n_grams: [[5, 0.15], [6, 0.14], [7, 0.13], [8, 0.12], [9, 0.11], [10, 0.10]]
+  - type: GopherQualityFilter
+    min_doc_words: 10
+    max_doc_words: 100000
+    min_avg_word_length: 2.0
+    max_avg_word_length: 12.0
+    max_symbol_word_ratio: 0.1
+    max_bullet_lines_ratio: 0.9
+    max_ellipsis_lines_ratio: 0.3
+    max_non_alpha_words_ratio: 0.8
+    min_stop_words: 2
+    stop_words: [ "og", "er", "det", "en", "vi", "at", "den", "i" ]
+  - type: C4QualityFilter
+    split_paragraph: true
+    remove_citations: true
+    filter_no_terminal_punct: true
+    min_num_sentences: 3
+    min_words_per_line: 2
+    max_word_length: 1000
+    filter_lorem_ipsum: true
+    filter_javascript: true
+    filter_curly_bracket: true
+    filter_policy: true
+  - type: FineWebQualityFilter
+    line_punct_thr: 0.12
+    line_punct_exclude_zero: false
+    short_line_thr: 0.67
+    short_line_length: 30
+    char_duplicates_ratio: 0.1
+    new_line_ratio: 0.3
+"""
+
+_DANISH = (
+    "det er en god dag og vi skal ud at gå en tur i skoven solen skinner over "
+    "byen der er mange mennesker på gaden som har arbejde nu efter turen vil "
+    "vi gerne drikke en kop kaffe og spise lidt brød hjemme i haven det "
+    "bliver en dejlig eftermiddag fordi vejret er så godt børnene kommer hjem "
+    "fra skole om aftenen skal vi lave mad sammen og se en film i stuen"
+).split()
+
+_ENGLISH = (
+    "the quick brown fox jumps over the lazy dog and runs through green "
+    "fields near the river where people walk their dogs every morning before "
+    "work they stop for coffee at the small cafe on the corner"
+).split()
+
+
+def _sentence(rng, words, n_lo=4, n_hi=16) -> str:
+    n = int(rng.integers(n_lo, n_hi))
+    ws = [words[int(rng.integers(0, len(words)))] for _ in range(n)]
+    return " ".join(ws).capitalize() + "."
+
+
+def build_corpus() -> list:
+    rng = np.random.default_rng(SEED)
+    docs = []
+    for i in range(N_DOCS):
+        kind = rng.random()
+        if kind < 0.62:  # ordinary Danish web-ish text
+            n_sent = int(rng.integers(2, 35))
+            sents = [_sentence(rng, _DANISH) for _ in range(n_sent)]
+            parts, j = [], 0
+            while j < len(sents):
+                k = int(rng.integers(1, 4))
+                parts.append(" ".join(sents[j : j + k]))
+                j += k
+            content = "\n".join(parts)
+        elif kind < 0.72:  # English (language filter fodder)
+            content = " ".join(_sentence(rng, _ENGLISH) for _ in range(int(rng.integers(2, 12))))
+        elif kind < 0.77:  # heavy duplication
+            line = _sentence(rng, _DANISH, 3, 8)
+            content = (line + "\n") * int(rng.integers(4, 30))
+        elif kind < 0.82:  # short fragments
+            content = _sentence(rng, _DANISH, 2, 5)[: int(rng.integers(5, 40))]
+        elif kind < 0.86:  # citations / policy / javascript / curly lines
+            base = [_sentence(rng, _DANISH) for _ in range(6)]
+            extra = int(rng.integers(0, 4))
+            if extra == 0:
+                base[2] = base[2][:-1] + " [1], [2, 3]."
+            elif extra == 1:
+                base[2] = "Læs vores privacy policy her."
+            elif extra == 2:
+                base[2] = "Denne side bruger javascript til menuen."
+            else:
+                base[2] = "function f() { return 1; }"
+            content = "\n".join(base)
+        elif kind < 0.88:  # lorem ipsum
+            content = "Lorem ipsum dolor sit amet. " + _sentence(rng, _DANISH)
+        elif kind < 0.92:  # unicode stress
+            content = (
+                _sentence(rng, _DANISH)
+                + "\nCafé naïve façade — øæå ÆØÅ 😊 日本語のテキスト.\n"
+                + _sentence(rng, _DANISH)
+            )
+        elif kind < 0.96:  # long docs (big bucket)
+            n_sent = int(rng.integers(60, 120))
+            content = "\n".join(_sentence(rng, _DANISH) for _ in range(n_sent))
+        elif kind < 0.975:  # word-table overflow inside the bucket (device
+            # fallback): > bucket/4 words of ~2.6 chars each
+            n_words = int(rng.integers(2100, 2800))
+            content = " ".join(
+                _DANISH[int(rng.integers(0, 10))][:2] for _ in range(n_words)
+            ) + "."
+        elif kind < 0.99:  # over-length docs (> largest bucket -> packer fallback)
+            n_sent = int(rng.integers(150, 260))
+            content = " ".join(_sentence(rng, _DANISH) for _ in range(n_sent))
+        else:  # empty-ish
+            content = "   \n  " if rng.random() < 0.5 else ""
+        docs.append(TextDocument(id=f"e2e-{i}", source="shard", content=content))
+    return docs
+
+
+def _fingerprint(docs) -> str:
+    h = hashlib.sha256()
+    for d in docs:
+        h.update(d.id.encode())
+        h.update(b"\x00")
+        h.update(d.content.encode())
+        h.update(b"\x01")
+    return h.hexdigest()
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    return build_corpus()
+
+
+@pytest.fixture(scope="module")
+def host_outcomes(corpus):
+    config = parse_pipeline_config(YAML)
+    executor = build_pipeline_from_config(config)
+    docs = [d.copy() for d in corpus]
+    return {o.document.id: o for o in process_documents_host(executor, iter(docs))}
+
+
+# Hard-pinned content hash of the generated shard: numpy/platform rng drift
+# or generator edits fail here first, not as an opaque parity mismatch below.
+CORPUS_SHA256 = "3bed338f1ee0468f121b12b2d55290dc904c1f30fedda07bc63e506d7c58293f"
+
+
+def test_corpus_fingerprint(corpus):
+    assert len(corpus) == N_DOCS
+    assert _fingerprint(corpus) == CORPUS_SHA256
+    lengths = [len(d.content) for d in corpus]
+    assert max(lengths) > 8192  # over-length outliers present
+    assert min(lengths) == 0  # empties present
+
+
+def _assert_outcomes_match(host, dev, tag):
+    assert set(dev) == set(host)
+    mismatch = [k for k in host if dev[k].kind != host[k].kind]
+    assert not mismatch, f"{tag}: {len(mismatch)} decision mismatches, e.g. {mismatch[:5]}"
+    for k, ho in host.items():
+        do = dev[k]
+        assert do.reason == ho.reason, (tag, k, do.reason, ho.reason)
+        assert do.document.content == ho.document.content, (tag, k)
+        assert do.document.metadata == ho.document.metadata, (tag, k)
+
+
+def test_device_single_matches_host_10k(corpus, host_outcomes):
+    config = parse_pipeline_config(YAML)
+    docs = [d.copy() for d in corpus]
+    dev = {
+        o.document.id: o
+        for o in process_documents_device(
+            config, iter(docs), device_batch=512, buckets=BUCKETS
+        )
+    }
+    _assert_outcomes_match(host_outcomes, dev, "single-device")
+
+
+def test_device_mesh8_matches_host_10k(corpus, host_outcomes):
+    from textblaster_tpu.parallel.mesh import data_mesh
+
+    config = parse_pipeline_config(YAML)
+    docs = [d.copy() for d in corpus]
+    dev = {
+        o.document.id: o
+        for o in process_documents_device(
+            config, iter(docs), device_batch=512, buckets=BUCKETS, mesh=data_mesh()
+        )
+    }
+    _assert_outcomes_match(host_outcomes, dev, "mesh8")
+
+
+def test_cli_roundtrip_matches_host_10k(corpus, host_outcomes, tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from textblaster_tpu.cli import main
+
+    table = pa.table(
+        {
+            "id": [d.id for d in corpus],
+            "text": [d.content for d in corpus],
+        }
+    )
+    inp = tmp_path / "shard.parquet"
+    pq.write_table(table, inp)
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(YAML, encoding="utf-8")
+    out, excl = tmp_path / "out.parquet", tmp_path / "excl.parquet"
+
+    rc = main(
+        [
+            "run",
+            "--input-file", str(inp),
+            "--pipeline-config", str(cfg),
+            "--output-file", str(out),
+            "--excluded-file", str(excl),
+            "--device-batch", "512",
+            "--quiet",
+        ]
+    )
+    assert rc == 0
+    kept = set(pq.read_table(out).column("id").to_pylist())
+    excluded = set(pq.read_table(excl).column("id").to_pylist())
+    host_kept = {k for k, o in host_outcomes.items() if o.kind == "Success"}
+    host_excl = {k for k, o in host_outcomes.items() if o.kind == "Filtered"}
+    assert kept == host_kept
+    assert excluded == host_excl
