@@ -1,0 +1,147 @@
+"""Multi-host execution test: 2 coordinated processes on CPU devices.
+
+The CPU stand-in for a 2-host pod (SURVEY.md §4: multi-chip tests via
+forced host-platform device counts): two OS processes join one
+``jax.distributed`` coordinator, each feeds its own half of a document shard
+into the globally-sharded compiled pipeline
+(``textblaster_tpu/parallel/multihost.py``), and each emits outcomes for its
+local documents.  The merged outcomes must be bit-identical to the host
+oracle over the full shard.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from textblaster_tpu.config.pipeline import parse_pipeline_config
+from textblaster_tpu.data_model import ProcessingOutcome, TextDocument
+from textblaster_tpu.orchestration import process_documents_host
+from textblaster_tpu.pipeline_builder import build_pipeline_from_config
+
+YAML = """
+pipeline:
+  - type: LanguageDetectionFilter
+    min_confidence: 0.5
+    allowed_languages: [ "dan", "eng" ]
+  - type: GopherRepetitionFilter
+    dup_line_frac: 0.3
+    top_n_grams: [[2, 0.25]]
+    dup_n_grams: [[5, 0.15]]
+  - type: GopherQualityFilter
+    min_doc_words: 4
+    min_stop_words: 1
+    stop_words: [ "og", "the", "er", "i" ]
+  - type: FineWebQualityFilter
+    line_punct_thr: 0.1
+    line_punct_exclude_zero: false
+    short_line_thr: 0.95
+    short_line_length: 8
+    char_duplicates_ratio: 0.5
+    new_line_ratio: 0.5
+"""
+
+
+def _docs():
+    base = [
+        "Det er en god dag i dag, og vi skal ud at gå en lang tur i skoven nu.",
+        "The quick brown fox jumps over the lazy dog and the old stone bridge.",
+        "Samme linje her igen.\n" * 6,
+        "kort.",
+        "Endnu en dansk tekst om vejret, og den er ganske lang og fin at læse.",
+        "Vi mødes nede ved havnen i morgen, og så sejler vi ud på vandet.",
+    ]
+    rng = np.random.default_rng(11)
+    docs = []
+    for i in range(48):
+        t = base[i % len(base)]
+        if rng.random() < 0.2:
+            t = t + " Og lidt mere tekst til sidst her."
+        docs.append(TextDocument(id=f"mh-{i}", source="s", content=t))
+    return docs
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_distributed_run_matches_oracle(tmp_path: Path):
+    docs = _docs()
+    halves = [docs[::2], docs[1::2]]
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(YAML, encoding="utf-8")
+    port = _free_port()
+
+    procs = []
+    try:
+        for pid in (0, 1):
+            inp = tmp_path / f"in{pid}.jsonl"
+            inp.write_text(
+                "".join(d.to_json() + "\n" for d in halves[pid]), encoding="utf-8"
+            )
+            env = {
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+                "PATH": "/usr/bin:/bin:/usr/local/bin",
+                "HOME": "/root",
+            }
+            procs.append(
+                subprocess.Popen(
+                    [
+                        sys.executable, "-m",
+                        "textblaster_tpu.parallel.multihost",
+                        "--coordinator", f"localhost:{port}",
+                        "--num-processes", "2",
+                        "--process-id", str(pid),
+                        "--pipeline-config", str(cfg),
+                        "--input-jsonl", str(inp),
+                        "--output-jsonl", str(tmp_path / f"out{pid}.jsonl"),
+                        "--bucket", "512",
+                        "--rounds", "1",
+                    ],
+                    cwd=str(Path(__file__).parent.parent),
+                    env=env,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT,
+                    text=True,
+                )
+            )
+        outputs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=560)
+            outputs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, out in zip(procs, outputs):
+        assert p.returncode == 0, out[-2000:]
+
+    merged = {}
+    for pid in (0, 1):
+        for line in (tmp_path / f"out{pid}.jsonl").read_text().splitlines():
+            if line.strip():
+                o = ProcessingOutcome.from_json(line)
+                merged[o.document.id] = o
+
+    config = parse_pipeline_config(YAML)
+    host = {
+        o.document.id: o
+        for o in process_documents_host(
+            build_pipeline_from_config(config), iter(_docs())
+        )
+    }
+    assert set(merged) == set(host)
+    for k, ho in host.items():
+        mo = merged[k]
+        assert mo.kind == ho.kind, (k, mo.kind, ho.kind)
+        assert mo.reason == ho.reason, k
+        assert mo.document.metadata == ho.document.metadata, k

@@ -24,11 +24,22 @@ import numpy as np
 
 from ..data_model import TextDocument
 
-__all__ = ["PackedBatch", "DEFAULT_BUCKETS", "pack_documents", "iter_packed_batches"]
+__all__ = [
+    "PackedBatch",
+    "DEFAULT_BUCKETS",
+    "PACK_MARGIN",
+    "pack_documents",
+    "iter_packed_batches",
+]
 
 # Bucket char capacities.  Most CC documents are < 8k chars; the tail gets the
 # big bucket and true outliers (>64k chars) fall back to the host oracle.
 DEFAULT_BUCKETS: Tuple[int, ...] = (512, 2048, 8192, 32768, 65536)
+
+#: Kernels need a little headroom past the content (e.g. the language-ID
+#: stream wraps the text in boundary markers), so a bucket admits documents
+#: only up to this many chars below its capacity.
+PACK_MARGIN = 4
 
 
 @dataclass
@@ -98,10 +109,7 @@ def iter_packed_batches(
     A final partial batch per bucket is flushed at stream end.
     """
     buckets = tuple(sorted(buckets))
-    # Kernels need a little headroom past the content (e.g. the language-ID
-    # stream wraps the text in boundary markers), so a bucket admits documents
-    # only up to 4 chars below its capacity.
-    margin = 4
+    margin = PACK_MARGIN
     largest = buckets[-1] - margin
     pending: dict[int, List[TextDocument]] = {b: [] for b in buckets}
     overflow: List[TextDocument] = []

@@ -339,14 +339,23 @@ class CompiledPipeline:
             return out
 
         if self.mesh is not None:
-            from ..parallel.mesh import batch_sharding
+            from jax.sharding import NamedSharding, PartitionSpec
 
+            from ..parallel.mesh import DATA_AXIS, batch_sharding
+
+            # Outputs must stay data-sharded (leading dim on the data axis,
+            # trailing dims replicated): without out_shardings XLA may pick a
+            # replicated layout, and the multi-host path reads each process's
+            # addressable rows as *its* documents' stats — replication would
+            # silently hand every host process-0's rows.
+            out_sharding = NamedSharding(self.mesh, PartitionSpec(DATA_AXIS))
             return jax.jit(
                 fn,
                 in_shardings=(
                     batch_sharding(self.mesh, 2),
                     batch_sharding(self.mesh, 1),
                 ),
+                out_shardings=out_sharding,
             )
         return jax.jit(fn)
 

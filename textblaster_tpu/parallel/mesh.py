@@ -10,9 +10,12 @@ per-document kernels have no cross-document dependencies, so XLA partitions
 them without inserting any collectives until the output gather; scaling is
 linear in chips modulo input-feed bandwidth.
 
-Multi-host: under ``jax.distributed`` the same code runs with a global mesh —
-each host feeds its local shard (``host_local_array_to_global_array``), and
-output gathers ride DCN.  Single-host multi-chip needs no extra code.
+Multi-host: :mod:`textblaster_tpu.parallel.multihost` — every process joins a
+``jax.distributed`` coordinator, the mesh spans all hosts' devices, each host
+feeds its local shard (``jax.make_array_from_process_local_data``) and
+assembles outcomes from its addressable output rows; cross-host traffic rides
+DCN where XLA places it.  Exercised by ``tests/test_multihost.py`` as a
+2-process CPU job.  Single-host multi-chip needs no extra code.
 """
 
 from __future__ import annotations

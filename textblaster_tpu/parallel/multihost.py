@@ -1,0 +1,208 @@
+"""Multi-host execution: per-host document feed over a global device mesh.
+
+The reference scales across machines by pointing more worker processes at one
+RabbitMQ broker (SURVEY.md §2.5); the TPU-native equivalent is a
+``jax.distributed`` SPMD job.  Every process joins one coordinator, the
+``data`` mesh spans all hosts' devices, each host packs and feeds only its
+*local* shard of the document stream
+(``jax.make_array_from_process_local_data``), the compiled pipeline executes
+once globally per round — cross-host traffic rides DCN exactly where XLA
+places it — and each host assembles outcomes for its own documents from its
+addressable output shards (the results-queue analogue: outputs land where
+the documents came from, ready for per-host Parquet shards).
+
+Lockstep contract: multi-host SPMD requires every process to dispatch the
+same programs in the same order, so a run uses ONE bucket length and a fixed
+number of rounds; hosts with fewer documents pad with empty batches.  The
+driver entry (``python -m textblaster_tpu.parallel.multihost``) and
+``tests/test_multihost.py`` demonstrate a 2-process run on CPU devices and
+check bit-parity against the host oracle.
+
+On real pods the same code runs unchanged: ``initialize()`` picks up the TPU
+coordinator, the mesh spans the slice, and ICI/DCN routing is XLA's choice —
+no NCCL/MPI analogue to manage (SURVEY.md §2.5's north-star mapping).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterable, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..config.pipeline import PipelineConfig
+from ..data_model import ProcessingOutcome, TextDocument
+from ..ops.packing import pack_documents
+from .mesh import DATA_AXIS, batch_sharding
+
+__all__ = ["initialize", "global_data_mesh", "run_local_shard"]
+
+
+def initialize(
+    coordinator: str, num_processes: int, process_id: int
+) -> None:
+    """Join the distributed job (no-op if this process already joined).
+
+    ``coordinator`` is ``host:port`` of process 0 — the moral equivalent of
+    the reference's ``--amqp-addr`` (utils/common.rs:15), except the
+    connection carries collectives instead of JSON tasks."""
+    if jax.distributed.is_initialized():
+        return
+    jax.distributed.initialize(
+        coordinator, num_processes=num_processes, process_id=process_id
+    )
+
+
+def global_data_mesh() -> "jax.sharding.Mesh":
+    """1-D ``data`` mesh over every device of every process."""
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()), (DATA_AXIS,))
+
+
+def _local_stats(out: dict) -> dict:
+    """This process's rows of every ``data``-sharded output, in row order,
+    moved in ONE bundled transfer (per-key np.asarray is a synchronous round
+    trip each on remote-tunnel backends — see assemble_batch)."""
+    shard_tree = {
+        k: [
+            s.data
+            for s in sorted(
+                v.addressable_shards, key=lambda s: s.index[0].start or 0
+            )
+        ]
+        for k, v in out.items()
+    }
+    host_tree = jax.device_get(shard_tree)
+    return {
+        k: (np.concatenate(parts, axis=0) if parts else np.empty((0,)))
+        for k, parts in host_tree.items()
+    }
+
+
+def run_local_shard(
+    config: PipelineConfig,
+    docs: Sequence[TextDocument],
+    bucket: int,
+    rounds: int,
+    mesh=None,
+    pipeline=None,
+) -> List[ProcessingOutcome]:
+    """Run this host's documents through the globally-sharded pipeline.
+
+    Every participating process must call this with the same ``config``,
+    ``bucket`` and ``rounds`` (lockstep); ``rounds`` must satisfy
+    ``rounds * local_batch >= len(docs)`` on every host, where
+    ``local_batch = global_batch / num_processes``.  Documents longer than
+    the bucket run the host oracle locally (the usual counted fallback).
+
+    Returns outcomes for **this host's** documents only.
+    """
+    from ..ops.pipeline import CompiledPipeline
+    from ..orchestration import execute_processing_pipeline
+    from ..utils.metrics import METRICS
+
+    from ..ops.packing import PACK_MARGIN
+
+    mesh = mesh if mesh is not None else global_data_mesh()
+    n_proc = jax.process_count()
+    if pipeline is None:
+        pipeline = CompiledPipeline(config, buckets=(bucket,), mesh=mesh)
+    local_batch = pipeline.batch_size // n_proc
+
+    fits, fallback = [], []
+    for d in docs:
+        (fits if len(d.content) <= bucket - PACK_MARGIN else fallback).append(d)
+    # Lockstep safety: EVERY process must agree the round budget is enough —
+    # a unilateral raise here while peers enter fn() would hang the job until
+    # the coordinator heartbeat tears it down.  One small allgather makes the
+    # failure synchronous and attributable.
+    needed_local = math.ceil(len(fits) / local_batch)
+    if n_proc > 1:
+        from jax.experimental import multihost_utils
+
+        needed_all = multihost_utils.process_allgather(
+            np.array([needed_local], dtype=np.int32)
+        ).reshape(-1)
+        needed = int(needed_all.max())
+    else:
+        needed = needed_local
+    if needed > rounds:
+        raise ValueError(
+            f"shard needs {needed} rounds (local {needed_local}), got {rounds}"
+        )
+
+    sh2 = batch_sharding(mesh, 2)
+    sh1 = batch_sharding(mesh, 1)
+    fn = pipeline._fn_for(bucket)
+
+    outcomes: List[ProcessingOutcome] = []
+    pending = None  # (local_batch, device_out): one round in flight
+    for r in range(rounds):
+        chunk = fits[r * local_batch : (r + 1) * local_batch]
+        local = pack_documents(chunk, batch_size=local_batch, max_len=bucket)
+        g_cps = jax.make_array_from_process_local_data(sh2, local.cps)
+        g_len = jax.make_array_from_process_local_data(sh1, local.lengths)
+        out = fn(g_cps, g_len)
+        if pending is not None:
+            outcomes.extend(
+                pipeline.assemble_batch(pending[0], _local_stats(pending[1]))
+            )
+        pending = (local, out)
+    if pending is not None:
+        outcomes.extend(
+            pipeline.assemble_batch(pending[0], _local_stats(pending[1]))
+        )
+
+    for d in fallback:
+        METRICS.inc("worker_host_fallback_total")
+        o = execute_processing_pipeline(pipeline.host_executor, d)
+        if o is not None:
+            outcomes.append(o)
+    return outcomes
+
+
+def _main(argv: Optional[Sequence[str]] = None) -> int:
+    """Per-process driver: JSONL docs in, JSONL outcomes out.
+
+    The 2-process form (one per "host") is the CPU stand-in for a multi-host
+    pod — see tests/test_multihost.py."""
+    import argparse
+    import json
+
+    from ..config.pipeline import load_pipeline_config
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--coordinator", required=True)
+    ap.add_argument("--num-processes", type=int, required=True)
+    ap.add_argument("--process-id", type=int, required=True)
+    ap.add_argument("--pipeline-config", required=True)
+    ap.add_argument("--input-jsonl", required=True)
+    ap.add_argument("--output-jsonl", required=True)
+    ap.add_argument("--bucket", type=int, default=2048)
+    ap.add_argument("--rounds", type=int, required=True)
+    args = ap.parse_args(argv)
+
+    initialize(args.coordinator, args.num_processes, args.process_id)
+    config = load_pipeline_config(args.pipeline_config)
+    docs = []
+    with open(args.input_jsonl, encoding="utf-8") as f:
+        for line in f:
+            if line.strip():
+                docs.append(TextDocument.from_json(line))
+    outcomes = run_local_shard(config, docs, bucket=args.bucket, rounds=args.rounds)
+    with open(args.output_jsonl, "w", encoding="utf-8") as f:
+        for o in outcomes:
+            f.write(o.to_json() + "\n")
+    print(
+        f"process {args.process_id}: {len(docs)} docs in, "
+        f"{len(outcomes)} outcomes out"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(_main())
