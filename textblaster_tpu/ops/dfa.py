@@ -29,7 +29,31 @@ def dfa_states(
 
     Returns:
       ``[B, L] int32`` — state *after* consuming each char.
+
+    For <= 8 states the per-char state maps are nibble-packed into one int32
+    (state ``s``'s successor in bits ``4s..4s+3``) and composed with
+    elementwise shifts — no gathers, which cost far more than ALU on both
+    XLA:CPU and TPU.  Larger automata fall back to the gather composition.
     """
+    n_states = transition.shape[1]
+    if n_states <= 8:
+        packed_rows = np.zeros(transition.shape[0], dtype=np.int64)
+        for s in range(n_states):
+            packed_rows |= transition[:, s].astype(np.int64) << (4 * s)
+        table = jnp.asarray(packed_rows.astype(np.int32))
+        fns = table[char_classes]  # [B, L] int32, one packed map per char
+
+        def compose(a, b):
+            # (b . a)(s) = b[a[s]]: route each of a's nibbles through b.
+            out = jnp.zeros_like(a)
+            for s in range(n_states):
+                nib = (a >> (4 * s)) & 15
+                out = out | (((b >> (nib << 2)) & 15) << (4 * s))
+            return out
+
+        packed = jax.lax.associative_scan(compose, fns, axis=1)
+        return (packed >> (4 * start_state)) & 15
+
     table = jnp.asarray(transition, dtype=jnp.int32)  # [S, N]
     # Per-char transition row: f_i : state -> state, shape [B, L, N].
     fns = table[char_classes]

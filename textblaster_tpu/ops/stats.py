@@ -702,6 +702,11 @@ def _greedy_dup_bytes_batched(jobs) -> Dict[str, jax.Array]:
         # States 0..n-1; 0 = free.  Symbol 1 (dup) at a free position selects
         # the window and blocks the next n-1; any symbol decrements a block.
         # States >= n are unreachable padding (mapped to 0).
+        # (A nibble-packed two-word compose was tried and measured SLOWER
+        # than this gather form on XLA:CPU at 10 states — the per-nibble
+        # routing needs selects between the words; revisit only with TPU
+        # measurements in hand.  The <=8-state automata in ops/dfa.py do use
+        # the packed form, where it wins.)
         t = np.zeros((2, n_states), dtype=np.int32)
         for s in range(1, n):
             t[0, s] = s - 1
