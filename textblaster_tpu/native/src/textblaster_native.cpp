@@ -36,6 +36,7 @@ constexpr uint8_t kAlpha = 1 << 1;
 constexpr uint8_t kDigit = 1 << 2;
 constexpr uint8_t kWs = 1 << 3;
 constexpr uint8_t kPunct = 1 << 4;
+constexpr uint8_t kExtend = 1 << 7;  // UAX#29 WB4 attachers (chartables.EXTEND)
 
 // UAX#29 word-joining characters — mirrors _MID_LETTER/_MID_NUM/_MID_NUM_LET
 // in textblaster_tpu/utils/text.py (UAX#29-lite rule set).
@@ -201,6 +202,14 @@ int64_t tb_word_spans(const int32_t* cps, int64_t n, const uint8_t* cls,
       if (letter_ok || num_ok) word[i] = 2;  // joined, not a run starter class
     }
   }
+  // UAX#29 WB4 (lite): Extend/Format chars inherit the wordness of the
+  // nearest preceding non-Extend char (utils.text._attach_extend twin).
+  // Left-to-right, so marks chain through a run of Extends.
+  for (int64_t i = 1; i < n; ++i) {
+    if ((cls[i] & kExtend) != 0 && !word[i]) {
+      word[i] = word[i - 1] ? 1 : 0;
+    }
+  }
   int64_t count = 0;
   int64_t i = 0;
   while (i < n) {
@@ -221,13 +230,21 @@ int64_t tb_word_spans(const int32_t* cps, int64_t n, const uint8_t* cls,
       i = j;
     } else {
       // Standalone symbol "word": not whitespace, not reference punctuation.
-      if ((cls[i] & kWs) == 0 && (cls[i] & kPunct) == 0) {
+      // ZWSP (WordBreak=Other, not word-like) and bare Extend chars produce
+      // no token; a trailing Extend run attaches to the symbol (WB4) —
+      // mirror of utils.text.word_spans.
+      if ((cls[i] & kWs) == 0 && (cls[i] & kPunct) == 0 &&
+          (cls[i] & kExtend) == 0 && static_cast<uint32_t>(cps[i]) != 0x200B) {
+        int64_t j = i + 1;
+        while (j < n && (cls[j] & kExtend) != 0 && !word[j]) ++j;
         if (count >= max_spans) return -1;
         out_spans[2 * count] = static_cast<int32_t>(i);
-        out_spans[2 * count + 1] = static_cast<int32_t>(i + 1);
+        out_spans[2 * count + 1] = static_cast<int32_t>(j);
         ++count;
+        i = j;
+      } else {
+        ++i;
       }
-      ++i;
     }
   }
   return count;

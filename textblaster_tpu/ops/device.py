@@ -39,6 +39,7 @@ __all__ = [
     "PUNCT",
     "LOWER",
     "UPPER",
+    "EXTEND",
     "MID_LETTER_CPS",
     "MID_NUM_CPS",
     "MID_ALL_CPS",
@@ -53,6 +54,7 @@ WS = ct.WS
 PUNCT = ct.PUNCT
 LOWER = ct.LOWER
 UPPER = ct.UPPER
+EXTEND = ct.EXTEND
 
 HASH_MUL = np.int32(31)  # polynomial rolling-hash multiplier (int32 wraparound)
 
@@ -85,8 +87,11 @@ def lower_table() -> jax.Array:
 
 
 def classify(cps: jax.Array) -> jax.Array:
-    """Gather char classes; indices clipped like the host ``classify``."""
-    return class_table()[jnp.minimum(cps, ct._MAX_CP - 1)]
+    """Gather char classes; indices clipped like the host ``classify``,
+    with the same plane-14 EXTEND range check."""
+    cls = class_table()[jnp.minimum(cps, ct._MAX_CP - 1)]
+    plane14 = (cps >= ct._PLANE14_LO) & (cps < ct._PLANE14_HI)
+    return jnp.where(plane14, jnp.uint8(EXTEND), cls)
 
 
 def utf8_width(cps: jax.Array) -> jax.Array:
@@ -176,4 +181,13 @@ def word_mask(cps: jax.Array, cls: jax.Array) -> jax.Array:
         & ((prev_cls & DIGIT) != 0)
         & ((next_cls & DIGIT) != 0)
     )
-    return word | letter_ok | num_ok
+    word = word | letter_ok | num_ok
+
+    # UAX#29 WB4 (lite): Extend/Format chars inherit the wordness of the
+    # nearest preceding non-Extend char (utils.text._attach_extend twin).
+    # ``word`` is always False at Extend positions, so a segmented or-scan
+    # that RESETS at non-Extend positions holds each word flag through the
+    # following Extend run (leading Extend runs hold 0).
+    ext = (cls & EXTEND) != 0
+    held = seg_scan_or(word.astype(jnp.int32), ~ext)
+    return jnp.where(ext, held > 0, word)

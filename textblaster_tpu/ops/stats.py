@@ -38,6 +38,7 @@ from .device import (
     ALNUM,
     ALPHA,
     DIGIT,
+    EXTEND,
     LOWER,
     PUNCT,
     WS,
@@ -160,11 +161,18 @@ def structure(cps: jax.Array, lengths: jax.Array) -> TextStructure:
     in_word = word_mask(cps, cls) & mask
     ws = (cls & WS) != 0
     punct = (cls & PUNCT) != 0
-    symbol = ~in_word & ~ws & ~punct & mask
+    ext = ((cls & EXTEND) != 0) & mask
+    # Symbols: not word/ws/punct; ZWSP yields no token (WordBreak=Other and
+    # not word-like in ICU), bare Extend chars yield no token, and an Extend
+    # run after a symbol CONTINUES that symbol's unit (WB4) — mirror of
+    # utils.text.word_spans.
+    base_symbol = ~in_word & ~ws & ~punct & mask & (cps != 0x200B) & ~ext
+    held_sym = seg_scan_or(base_symbol.astype(jnp.int32), ~ext) > 0
+    symbol = base_symbol | (ext & ~in_word & held_sym)
 
     in_unit = in_word | symbol
     prev_in_word = _shift_r(in_word, False)
-    unit_start = (in_word & ~prev_in_word) | symbol
+    unit_start = (in_word & ~prev_in_word) | base_symbol
     next_start = _shift_l(unit_start, False)
     next_in_unit = _shift_l(in_unit, False)
     unit_end = in_unit & (~next_in_unit | next_start)

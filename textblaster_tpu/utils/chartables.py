@@ -20,6 +20,7 @@ __all__ = [
     "PUNCT",
     "LOWER",
     "UPPER",
+    "EXTEND",
     "char_table",
     "classify",
     "codepoints",
@@ -35,6 +36,9 @@ WS = 1 << 3  # str.isspace()  (char::is_whitespace parity)
 PUNCT = 1 << 4  # membership in the reference PUNCTUATION set (text.rs:40-57)
 LOWER = 1 << 5  # str.islower() (sentence segmentation SB8)
 UPPER = 1 << 6  # str.isupper()
+EXTEND = 1 << 7  # UAX#29 WB4 attachers: combining marks (Mn/Mc/Me) + format
+#                  (Cf) chars that are not already alphanumeric — they extend
+#                  the preceding word instead of breaking it (NFD text parity)
 
 # Exactly the literal punctuation characters of the reference (text.rs:28-29).
 PUNCTUATION_LIT = (
@@ -50,14 +54,20 @@ PUNCTUATION = frozenset(PUNCTUATION_LIT) | frozenset(
 )
 
 # Table covers planes 0-3 (0x0-0x3FFFF): everything assigned an alphanumeric /
-# space / punctuation property lives below this bound (planes 4+ are unassigned
-# or private-use, which classify as 0 — same as Python's str predicates return
-# for them).  Lookups clip the index, so any codepoint is safe to classify.
+# space / punctuation property lives below this bound, EXCEPT the plane-14
+# tag/variation-selector block (U+E0000-E01EF, all Mn/Cf = EXTEND), which
+# ``classify`` handles with a range check so emoji tag sequences attach
+# instead of shattering into symbol tokens.  Planes 4+ are otherwise
+# unassigned or private-use, classifying as 0 — same as Python's str
+# predicates.  Lookups clip the index, so any codepoint is safe to classify.
 _MAX_CP = 0x40000
+_PLANE14_LO, _PLANE14_HI = 0xE0000, 0xE0200
 _TABLE: np.ndarray | None = None
 
 
 def _build_table() -> np.ndarray:
+    import unicodedata
+
     table = np.zeros(_MAX_CP, dtype=np.uint8)
     for cp in range(_MAX_CP):
         c = chr(cp)
@@ -74,6 +84,14 @@ def _build_table() -> np.ndarray:
             v |= LOWER
         if c.isupper():
             v |= UPPER
+        # UAX#29 Format excludes ZWSP (U+200B): it BREAKS words, it does not
+        # join them (WordBreak=Other).  ZWNJ/ZWJ stay attachers.
+        if (
+            not (v & ALNUM)
+            and cp != 0x200B
+            and unicodedata.category(c) in ("Mn", "Mc", "Me", "Cf")
+        ):
+            v |= EXTEND
         if v:
             table[cp] = v
     for ch in PUNCTUATION:
@@ -90,9 +108,14 @@ def char_table() -> np.ndarray:
 
 
 def classify(cps: np.ndarray) -> np.ndarray:
-    """Classify a codepoint array; indices are clipped into the table."""
+    """Classify a codepoint array; indices are clipped into the table.
+    Plane-14 tag/variation-selector chars classify as EXTEND by range."""
     table = char_table()
-    return table[np.minimum(cps, _MAX_CP - 1).astype(np.int64)]
+    cls = table[np.minimum(cps, _MAX_CP - 1).astype(np.int64)]
+    plane14 = (cps >= _PLANE14_LO) & (cps < _PLANE14_HI)
+    if plane14.any():
+        cls = np.where(plane14, np.uint8(EXTEND), cls)
+    return cls
 
 
 def codepoints(text: str) -> np.ndarray:

@@ -34,8 +34,34 @@ from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
 
-from .chartables import ALNUM, ALPHA, DIGIT, PUNCT, WS, classify, codepoints
+from .chartables import (
+    ALNUM,
+    ALPHA,
+    DIGIT,
+    EXTEND,
+    PUNCT,
+    WS,
+    classify,
+    codepoints,
+)
 from .chartables import PUNCTUATION  # re-export for filters  # noqa: F401
+
+
+def _attach_extend(word: np.ndarray, cls: np.ndarray) -> np.ndarray:
+    """UAX#29 WB4 (lite): Extend/Format chars inherit the wordness of the
+    nearest preceding non-Extend char, so decomposed accents stay inside
+    their word instead of shattering it (``'cafe\\u0301'`` is one word).
+    Leading Extend runs keep their own (non-word) class."""
+    ext = (cls & EXTEND) != 0
+    if not ext.any():
+        return word
+    n = word.shape[0]
+    idx = np.arange(n)
+    src = np.maximum.accumulate(np.where(~ext, idx, -1))
+    ok = ext & (src >= 0)
+    out = word.copy()
+    out[ok] = word[src[ok]]
+    return out
 
 try:  # native C++ fast path (lazy-built; None => pure numpy)
     from ..native import word_spans_native as _native_spans
@@ -90,7 +116,7 @@ def _word_mask(cps: np.ndarray, cls: np.ndarray) -> np.ndarray:
     n = cps.shape[0]
     word = ((cls & ALNUM) != 0) | (cps == ord("_"))
     if n < 3:
-        return word
+        return _attach_extend(word, cls)
     # A mid character joins two word characters when flanked by the right class.
     mid = np.isin(cps, _MID_CP)
     if mid.any():
@@ -109,7 +135,7 @@ def _word_mask(cps: np.ndarray, cls: np.ndarray) -> np.ndarray:
         )
         joined = inner & (letter_ok | num_ok)
         word[1:-1] |= joined
-    return word
+    return _attach_extend(word, cls)
 
 
 def word_spans(text: str) -> List[Tuple[int, int]]:
@@ -143,12 +169,27 @@ def word_spans(text: str) -> List[Tuple[int, int]]:
 
     # Standalone symbol "words": not in a run, not whitespace, not reference
     # punctuation (ICU yields isolated symbols as their own segments and the
-    # rejection loop keeps them).
+    # rejection loop keeps them).  ZWSP is WordBreak=Other AND not word-like
+    # in ICU, so it produces no token at all; a trailing Extend/Format run
+    # attaches to the symbol (WB4 — e.g. emoji tag sequences stay one token).
+    ext = (cls & EXTEND) != 0
     sym = ~in_word & ((cls & WS) == 0) & ((cls & PUNCT) == 0)
+    sym &= cps != 0x200B
+    sym &= ~ext  # bare Extend after ws/punct: no token (its segment would be
+    #              punctuation-only / rejected in ICU terms)
     sym_pos = np.flatnonzero(sym)
 
+    # End of each symbol token: swallow the following Extend run.
+    ext_pad = np.zeros(n + 1, dtype=bool)
+    ext_pad[:-1] = ext
+    nonext_idx = np.arange(n + 1)
+    # next non-extend position at-or-after i (scan from the right)
+    nxt = np.minimum.accumulate(
+        np.where(~ext_pad, nonext_idx, n)[::-1]
+    )[::-1]
+
     spans = [(int(s), int(e)) for s, e, k in zip(starts, ends, keep) if k]
-    spans.extend((int(p), int(p) + 1) for p in sym_pos)
+    spans.extend((int(p), int(nxt[p + 1])) for p in sym_pos)
     spans.sort()
     return spans
 
