@@ -207,31 +207,44 @@ class LangIdModel:
         return self.table_q[h].sum(axis=0, dtype=np.int64), len(h)
 
     @staticmethod
-    def decide(scores_q: np.ndarray, n_grams: int) -> Tuple[str, float]:
-        """(language display name, confidence) from quantized score totals.
+    def decide_batch(
+        scores_q: np.ndarray, n_grams: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Vectorized decision over ``scores_q [B, n_langs]`` / ``n_grams [B]``
+        -> ``(winner index [B], confidence [B])``.
 
         Confidence is the softmax probability of the winner over the candidate
         set, on length-normalized log-likelihoods re-scaled by a bounded
         evidence factor — short texts stay uncertain, long unambiguous texts
-        approach 1.0, mirroring lingua's behavior.
+        approach 1.0, mirroring lingua's behavior.  All arithmetic is float64
+        and row-wise identical to the scalar form, so host and device
+        finalizers decide bit-identically.
         """
-        n_grams = max(n_grams, 1)
-        s = scores_q.astype(np.float64) / SCORE_SCALE
+        ng = np.maximum(np.asarray(n_grams, dtype=np.int64), 1).astype(np.float64)
+        s = np.asarray(scores_q).astype(np.float64) / SCORE_SCALE
         # Quadratic damping for tiny inputs (a 2-trigram fragment must stay
         # uncertain however lopsided its per-trigram scores), capped growth
         # for long ones.
-        evidence = min(float(n_grams), 400.0) * (n_grams / (n_grams + 25.0))
-        z = (s / n_grams) * evidence
-        z = z - z.max()
+        evidence = np.minimum(ng, 400.0) * (ng / (ng + 25.0))
+        z = (s / ng[:, None]) * evidence[:, None]
+        z = z - z.max(axis=1, keepdims=True)
         # Bound the spread so the winner's softmax stays strictly below 1.0
         # in float64 — lingua never reports exactly 1.0 either, and the
         # min_confidence=1.0 configuration must filter everything
         # (language_filter.rs:74-82 semantics).
         z = np.maximum(z, -30.0)
         p = np.exp(z)
-        p /= p.sum()
-        best = int(p.argmax())
-        return LANGUAGES[best], float(p[best])
+        p /= p.sum(axis=1, keepdims=True)
+        best = p.argmax(axis=1)
+        return best, p[np.arange(p.shape[0]), best]
+
+    @staticmethod
+    def decide(scores_q: np.ndarray, n_grams: int) -> Tuple[str, float]:
+        """Scalar form of :meth:`decide_batch` (one document)."""
+        best, conf = LangIdModel.decide_batch(
+            np.asarray(scores_q)[None, :], np.array([n_grams])
+        )
+        return LANGUAGES[int(best[0])], float(conf[0])
 
     def detect(self, text: str) -> Optional[Tuple[str, float]]:
         scored = self.scores_q(text)

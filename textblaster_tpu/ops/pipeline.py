@@ -36,7 +36,7 @@ from ..filters.c4_quality import CITATION_RE
 from ..filters.common import fmt2, fmt4, rust_bool, rust_float, rust_lines
 from ..filters.gopher_quality import DEFAULT_STOP_WORDS
 from ..filters.fineweb_quality import DEFAULT_STOP_CHARS
-from ..models.langid import ISO_TO_NAME, NAME_TO_ISO, LangIdModel
+from ..models.langid import ISO_TO_NAME, LANGUAGES, NAME_TO_ISO, LangIdModel
 from ..orchestration import execute_processing_pipeline
 from ..pipeline_builder import build_pipeline_from_config
 from ..utils.metrics import METRICS
@@ -155,6 +155,33 @@ class _Decision:
         self.extra = extra
 
 
+class _StepEval:
+    """Batch-vectorized verdicts for one step (see finalizer section notes)."""
+
+    __slots__ = (
+        "passed",
+        "overflow",
+        "decide",
+        "pass_stamps",
+        "c4_line_keep",
+        "c4_n_lines",
+        "badwords_candidate",
+        "badwords_default_language",
+    )
+
+    def __init__(self, passed, decide, pass_stamps, overflow=None):
+        self.passed = passed
+        self.overflow = overflow
+        self.decide = decide
+        # Constant stamps for passing rows; None means even passing rows need
+        # decide() (per-row stamp values or host-side work).
+        self.pass_stamps = pass_stamps
+        self.c4_line_keep = None
+        self.c4_n_lines = None
+        self.badwords_candidate = None
+        self.badwords_default_language = None
+
+
 class CompiledPipeline:
     """A pipeline config compiled for device execution."""
 
@@ -197,7 +224,6 @@ class CompiledPipeline:
         self._host_executor = None
         self._host_suffix_executor = None
         self._jitted: Dict[int, Callable] = {}
-        self._langid = LangIdModel()
         self._badwords_steps: Dict[int, object] = {}
 
     def _badwords_host_step(self, idx: int):
@@ -365,352 +391,430 @@ class CompiledPipeline:
         return self._jitted[length]
 
     # --- host finalizers ----------------------------------------------------
+    #
+    # Threshold logic is evaluated ONCE per batch in vectorized numpy (float64
+    # ratios from the device's integer stats — identical arithmetic to the
+    # oracle filters'); per-row Python runs only to format reason strings and
+    # stamps for rows that need them.  The per-batch eval objects carry:
+    #   passed [B] bool   — step verdict per row (badwords: provisional)
+    #   overflow [B] bool — row hit a kernel table bound (host-oracle rerun)
+    #   pass_stamps       — constant stamps for passing rows (None: per-row)
+    #   decide(row, doc)  — full _Decision (fail rows / per-row-stamp steps)
 
-    def _finalize_step(
-        self, step: StepConfig, idx: int, stats: Dict[str, np.ndarray], row: int,
-        doc: TextDocument,
-    ) -> Tuple[_Decision, bool]:
-        """(decision, overflowed) for one step on one row."""
-        g = lambda key: stats[f"{idx}:{key}"][row]  # noqa: E731
+    def _eval_step(self, step: StepConfig, idx: int, stats: Dict[str, np.ndarray]):
+        try:
+            fn = _EVALS[step.type]
+        except KeyError:
+            raise PipelineError(f"no finalizer for step {step.type}") from None
+        return fn(self, step, idx, stats)
+
+    def _eval_langid(self, step: StepConfig, idx: int, stats) -> "_StepEval":
         p = step.params
+        scores = np.asarray(stats[f"{idx}:scores"])
+        n_grams = np.asarray(stats[f"{idx}:n_grams"]).astype(np.int64)
+        best, conf = LangIdModel.decide_batch(scores, n_grams)
 
-        if step.type == "LanguageDetectionFilter":
-            n_grams = int(g("n_grams"))
-            if n_grams <= 0:
-                return _Decision(False, "Language could not be confidently detected"), False
-            lang, conf = self._langid.decide(np.asarray(stats[f"{idx}:scores"][row]), n_grams)
+        valid = n_grams > 0
+        allowed = [c for c in p.allowed_languages if c in ISO_TO_NAME]
+        lang_allowed = np.array(
+            [NAME_TO_ISO[lang] in allowed for lang in LANGUAGES], dtype=bool
+        )[best]
+        conf_ok = conf >= p.min_confidence
+        passed = valid & lang_allowed & conf_ok
+        joined = "; ".join(allowed)
+
+        def decide(row: int, doc: TextDocument) -> _Decision:
+            if not valid[row]:
+                return _Decision(False, "Language could not be confidently detected")
             stamps = [
-                ("Detected language", lang),
-                ("Detected language confidence", rust_float(conf)),
+                ("Detected language", LANGUAGES[best[row]]),
+                ("Detected language confidence", rust_float(conf[row])),
             ]
-            allowed = [c for c in p.allowed_languages if c in ISO_TO_NAME]
-            if NAME_TO_ISO[lang] not in allowed:
-                joined = "; ".join(allowed)
-                return (
-                    _Decision(
-                        False,
-                        f'Document is not any of the following languages: "{joined}"',
-                        stamps,
-                    ),
+            if not lang_allowed[row]:
+                return _Decision(
                     False,
+                    f'Document is not any of the following languages: "{joined}"',
+                    stamps,
                 )
-            if conf < p.min_confidence:
-                return (
-                    _Decision(
-                        False,
-                        "Language detection confidence is not satified: "
-                        f"{rust_float(conf)} < {rust_float(p.min_confidence)}",
-                        stamps,
-                    ),
+            if not conf_ok[row]:
+                return _Decision(
                     False,
+                    "Language detection confidence is not satified: "
+                    f"{rust_float(conf[row])} < {rust_float(p.min_confidence)}",
+                    stamps,
                 )
-            return _Decision(True, stamps=stamps), False
+            return _Decision(True, stamps=stamps)
 
-        if step.type == "GopherRepetitionFilter":
-            overflow = bool(g("seg_overflow")) or bool(g("word_overflow"))
-            if overflow:
-                return _Decision(True), True
-            trimmed_len = int(g("trimmed_len"))
-            if trimmed_len == 0:
-                return (
-                    _Decision(
-                        False,
-                        "skipping empty content",
-                        [
-                            ("gopher_repetition_filter_status", "filtered"),
-                            ("gopher_repetition_filter_reason", "skipping empty content"),
-                        ],
-                    ),
+        # Langid stamps are per-row even on pass (detected language + conf).
+        return _StepEval(passed=passed, decide=decide, pass_stamps=None)
+
+    def _eval_gopher_rep(self, step: StepConfig, idx: int, stats) -> "_StepEval":
+        p = step.params
+        g = lambda key: np.asarray(stats[f"{idx}:{key}"]).astype(np.int64)  # noqa: E731
+        overflow = np.asarray(stats[f"{idx}:seg_overflow"], dtype=bool) | np.asarray(
+            stats[f"{idx}:word_overflow"], dtype=bool
+        )
+        trimmed = g("trimmed_len")
+        empty = trimmed == 0
+        char_len = np.maximum(trimmed, 1).astype(np.float64)
+
+        # (cond [B], ratio [B], reason template parts) per check, in the
+        # oracle's check order.
+        checks = []
+
+        def add(cond, ratio, label, thr):
+            checks.append((cond, ratio, label, thr))
+
+        ratio = g("para_dup_elems") / np.maximum(g("n_paragraphs"), 1)
+        if p.dup_para_frac is not None:
+            add(ratio > p.dup_para_frac, ratio, "dup_para_frac", p.dup_para_frac)
+        ratio = g("para_dup_bytes") / char_len
+        if p.dup_para_char_frac is not None:
+            add(
+                ratio > p.dup_para_char_frac,
+                ratio,
+                "dup_para_char_frac",
+                p.dup_para_char_frac,
+            )
+        ratio = g("line_dup_elems") / np.maximum(g("n_lines"), 1)
+        if p.dup_line_frac is not None:
+            add(ratio > p.dup_line_frac, ratio, "dup_line_frac", p.dup_line_frac)
+        ratio = g("line_dup_bytes") / char_len
+        if p.dup_line_char_frac is not None:
+            add(
+                ratio > p.dup_line_char_frac,
+                ratio,
+                "dup_line_char_frac",
+                p.dup_line_char_frac,
+            )
+        for n, thr in p.top_n_grams:
+            if n > 0:
+                ratio = g(f"top_{n}") / char_len
+                add(ratio > thr, ratio, f"top_{n}_gram", thr)
+        for n, thr in p.dup_n_grams:
+            if n > 0:
+                ratio = g(f"dup_{n}") / char_len
+                add(ratio > thr, ratio, f"duplicated_{n}_n_grams", thr)
+
+        any_cond = empty.copy()
+        for cond, _, _, _ in checks:
+            any_cond |= cond
+        passed = ~any_cond
+
+        def decide(row: int, doc: TextDocument) -> _Decision:
+            if empty[row]:
+                return _Decision(
                     False,
+                    "skipping empty content",
+                    [
+                        ("gopher_repetition_filter_status", "filtered"),
+                        ("gopher_repetition_filter_reason", "skipping empty content"),
+                    ],
                 )
-            text_char_len = float(max(trimmed_len, 1))
-            reasons: List[str] = []
-            ratio = int(g("para_dup_elems")) / max(int(g("n_paragraphs")), 1)
-            if p.dup_para_frac is not None and ratio > p.dup_para_frac:
-                reasons.append(
-                    f"dup_para_frac (ratio {fmt2(ratio)}, max {fmt2(p.dup_para_frac)})"
-                )
-            ratio = int(g("para_dup_bytes")) / text_char_len
-            if p.dup_para_char_frac is not None and ratio > p.dup_para_char_frac:
-                reasons.append(
-                    f"dup_para_char_frac (ratio {fmt2(ratio)}, "
-                    f"max {fmt2(p.dup_para_char_frac)})"
-                )
-            ratio = int(g("line_dup_elems")) / max(int(g("n_lines")), 1)
-            if p.dup_line_frac is not None and ratio > p.dup_line_frac:
-                reasons.append(
-                    f"dup_line_frac (ratio {fmt2(ratio)}, max {fmt2(p.dup_line_frac)})"
-                )
-            ratio = int(g("line_dup_bytes")) / text_char_len
-            if p.dup_line_char_frac is not None and ratio > p.dup_line_char_frac:
-                reasons.append(
-                    f"dup_line_char_frac (ratio {fmt2(ratio)}, "
-                    f"max {fmt2(p.dup_line_char_frac)})"
-                )
-            for n, thr in p.top_n_grams:
-                ratio = int(g(f"top_{n}")) / text_char_len
-                if n > 0 and ratio > thr:
-                    reasons.append(f"top_{n}_gram (ratio {fmt2(ratio)}, max {fmt2(thr)})")
-            for n, thr in p.dup_n_grams:
-                ratio = int(g(f"dup_{n}")) / text_char_len
-                if n > 0 and ratio > thr:
-                    reasons.append(
-                        f"duplicated_{n}_n_grams (ratio {fmt2(ratio)}, max {fmt2(thr)})"
-                    )
-            if reasons:
-                rs = "; ".join(reasons)
-                return (
-                    _Decision(
-                        False,
-                        rs,
-                        [
-                            ("gopher_repetition_filter_status", "filtered"),
-                            ("gopher_repetition_filter_reasons", rs),
-                        ],
-                    ),
-                    False,
-                )
-            return (
-                _Decision(True, stamps=[("gopher_repetition_filter_status", "passed")]),
+            reasons = [
+                f"{label} (ratio {fmt2(ratio[row])}, max {fmt2(thr)})"
+                for cond, ratio, label, thr in checks
+                if cond[row]
+            ]
+            rs = "; ".join(reasons)
+            return _Decision(
                 False,
+                rs,
+                [
+                    ("gopher_repetition_filter_status", "filtered"),
+                    ("gopher_repetition_filter_reasons", rs),
+                ],
             )
 
-        if step.type == "GopherQualityFilter":
-            n_non_symbol = int(g("n_non_symbol"))
-            n_words = int(g("n_words"))
-            sum_len = int(g("sum_word_len"))
-            avg = sum_len / n_non_symbol if n_non_symbol else 0.0
-            n_total_calc = float(max(n_words, 1))
-            hash_ratio = int(g("hash_count")) / n_total_calc
-            ellipsis_ratio = int(g("ellipsis_units")) / n_total_calc
-            n_lines_calc = float(max(int(g("n_lines")), 1))
-            bullet_ratio = int(g("bullet_lines")) / n_lines_calc
-            ell_lines_ratio = int(g("ellipsis_lines")) / n_lines_calc
-            alpha_ratio = int(g("alpha_words")) / n_total_calc
-            stop_count = int(g("stop_words"))
+        return _StepEval(
+            passed=passed,
+            overflow=overflow,
+            decide=decide,
+            pass_stamps=(("gopher_repetition_filter_status", "passed"),),
+        )
 
-            reasons = []
-            if p.min_doc_words is not None and n_non_symbol < p.min_doc_words:
-                reasons.append(
-                    f"gopher_short_doc ({n_non_symbol} non-symbol words, "
-                    f"required {p.min_doc_words})"
+    def _eval_gopher_quality(self, step: StepConfig, idx: int, stats) -> "_StepEval":
+        p = step.params
+        g = lambda key: np.asarray(stats[f"{idx}:{key}"]).astype(np.int64)  # noqa: E731
+        n_non_symbol = g("n_non_symbol")
+        n_words = g("n_words")
+        sum_len = g("sum_word_len")
+        avg = np.zeros(len(n_words), dtype=np.float64)
+        np.divide(sum_len, n_non_symbol, out=avg, where=n_non_symbol > 0)
+        n_total = np.maximum(n_words, 1).astype(np.float64)
+        hash_ratio = g("hash_count") / n_total
+        ellipsis_ratio = g("ellipsis_units") / n_total
+        n_lines_f = np.maximum(g("n_lines"), 1).astype(np.float64)
+        bullet_ratio = g("bullet_lines") / n_lines_f
+        ell_lines_ratio = g("ellipsis_lines") / n_lines_f
+        alpha_ratio = g("alpha_words") / n_total
+        stop_count = g("stop_words")
+
+        # (cond [B], reason_fn(row) -> str) in the oracle's check order.
+        checks = []
+        if p.min_doc_words is not None:
+            checks.append(
+                (
+                    n_non_symbol < p.min_doc_words,
+                    lambda r: f"gopher_short_doc ({n_non_symbol[r]} non-symbol words, "
+                    f"required {p.min_doc_words})",
                 )
-            if p.max_doc_words is not None and n_non_symbol > p.max_doc_words:
-                reasons.append(
-                    f"gopher_long_doc ({n_non_symbol} non-symbol words, "
-                    f"max {p.max_doc_words})"
+            )
+        if p.max_doc_words is not None:
+            checks.append(
+                (
+                    n_non_symbol > p.max_doc_words,
+                    lambda r: f"gopher_long_doc ({n_non_symbol[r]} non-symbol words, "
+                    f"max {p.max_doc_words})",
                 )
-            if p.min_avg_word_length is not None and avg < p.min_avg_word_length:
+            )
+        if p.min_avg_word_length is not None:
+
+            def _below_avg(r: int) -> str:
                 suffix = (
                     " - 0 non-symbol words"
-                    if n_non_symbol == 0 and p.min_avg_word_length > 0.0
+                    if n_non_symbol[r] == 0 and p.min_avg_word_length > 0.0
                     else ""
                 )
-                reasons.append(
-                    f"gopher_below_avg_threshold (avg len {fmt2(avg)}, "
+                return (
+                    f"gopher_below_avg_threshold (avg len {fmt2(avg[r])}, "
                     f"required {fmt2(p.min_avg_word_length)}{suffix})"
                 )
-            if (
-                p.max_avg_word_length is not None
-                and n_non_symbol > 0
-                and avg > p.max_avg_word_length
-            ):
-                reasons.append(
-                    f"gopher_above_avg_threshold (avg len {fmt2(avg)}, "
-                    f"max {fmt2(p.max_avg_word_length)})"
+
+            checks.append((avg < p.min_avg_word_length, _below_avg))
+        if p.max_avg_word_length is not None:
+            checks.append(
+                (
+                    (n_non_symbol > 0) & (avg > p.max_avg_word_length),
+                    lambda r: f"gopher_above_avg_threshold (avg len {fmt2(avg[r])}, "
+                    f"max {fmt2(p.max_avg_word_length)})",
                 )
-            if p.max_symbol_word_ratio is not None:
-                if hash_ratio > p.max_symbol_word_ratio:
-                    reasons.append(
-                        f"gopher_too_many_hashes (ratio {fmt2(hash_ratio)}, "
-                        f"max {fmt2(p.max_symbol_word_ratio)})"
-                    )
-                if ellipsis_ratio > p.max_symbol_word_ratio:
-                    reasons.append(
-                        f"gopher_too_many_ellipsis_units (ratio {fmt2(ellipsis_ratio)}, "
-                        f"max {fmt2(p.max_symbol_word_ratio)})"
-                    )
-            if (
-                p.max_bullet_lines_ratio is not None
-                and bullet_ratio > p.max_bullet_lines_ratio
-            ):
-                reasons.append(
-                    f"gopher_too_many_bullets (ratio {fmt2(bullet_ratio)}, "
-                    f"max {fmt2(p.max_bullet_lines_ratio)})"
+            )
+        if p.max_symbol_word_ratio is not None:
+            checks.append(
+                (
+                    hash_ratio > p.max_symbol_word_ratio,
+                    lambda r: f"gopher_too_many_hashes (ratio {fmt2(hash_ratio[r])}, "
+                    f"max {fmt2(p.max_symbol_word_ratio)})",
                 )
-            if (
-                p.max_ellipsis_lines_ratio is not None
-                and ell_lines_ratio > p.max_ellipsis_lines_ratio
-            ):
-                reasons.append(
-                    f"gopher_too_many_end_ellipsis_lines (ratio {fmt2(ell_lines_ratio)}, "
-                    f"max {fmt2(p.max_ellipsis_lines_ratio)})"
+            )
+            checks.append(
+                (
+                    ellipsis_ratio > p.max_symbol_word_ratio,
+                    lambda r: "gopher_too_many_ellipsis_units "
+                    f"(ratio {fmt2(ellipsis_ratio[r])}, "
+                    f"max {fmt2(p.max_symbol_word_ratio)})",
                 )
-            if (
-                p.max_non_alpha_words_ratio is not None
-                and alpha_ratio < p.max_non_alpha_words_ratio
-            ):
-                reasons.append(
-                    f"gopher_below_alpha_threshold (alpha ratio {fmt2(alpha_ratio)}, "
-                    f"required min {fmt2(p.max_non_alpha_words_ratio)})"
+            )
+        if p.max_bullet_lines_ratio is not None:
+            checks.append(
+                (
+                    bullet_ratio > p.max_bullet_lines_ratio,
+                    lambda r: f"gopher_too_many_bullets (ratio {fmt2(bullet_ratio[r])}, "
+                    f"max {fmt2(p.max_bullet_lines_ratio)})",
                 )
-            if (
-                p.min_stop_words is not None
-                and p.min_stop_words > 0
-                and stop_count < p.min_stop_words
-            ):
-                reasons.append(
-                    f"gopher_too_few_stop_words (found {stop_count}, "
-                    f"required {p.min_stop_words})"
+            )
+        if p.max_ellipsis_lines_ratio is not None:
+            checks.append(
+                (
+                    ell_lines_ratio > p.max_ellipsis_lines_ratio,
+                    lambda r: "gopher_too_many_end_ellipsis_lines "
+                    f"(ratio {fmt2(ell_lines_ratio[r])}, "
+                    f"max {fmt2(p.max_ellipsis_lines_ratio)})",
                 )
-            if reasons:
-                rs = "; ".join(reasons)
-                return (
-                    _Decision(
-                        False,
-                        rs,
-                        [
-                            ("gopher_quality_filter_status", "filtered"),
-                            ("gopher_quality_filter_reasons", rs),
-                        ],
-                    ),
-                    False,
+            )
+        if p.max_non_alpha_words_ratio is not None:
+            checks.append(
+                (
+                    alpha_ratio < p.max_non_alpha_words_ratio,
+                    lambda r: "gopher_below_alpha_threshold "
+                    f"(alpha ratio {fmt2(alpha_ratio[r])}, "
+                    f"required min {fmt2(p.max_non_alpha_words_ratio)})",
                 )
-            return (
-                _Decision(True, stamps=[("gopher_quality_filter_status", "passed")]),
-                False,
+            )
+        if p.min_stop_words is not None and p.min_stop_words > 0:
+            checks.append(
+                (
+                    stop_count < p.min_stop_words,
+                    lambda r: f"gopher_too_few_stop_words (found {stop_count[r]}, "
+                    f"required {p.min_stop_words})",
+                )
             )
 
-        if step.type == "C4QualityFilter":
-            if bool(g("line_overflow")):
-                return _Decision(True), True
-            reasons = []
-            if bool(g("has_lorem")):
-                reasons.append("lorem_ipsum")
-            if bool(g("has_curly")):
-                reasons.append("curly_bracket")
-            if reasons:
-                rs = "; ".join(reasons)
-                return (
-                    _Decision(
-                        False,
-                        rs,
-                        [("c4_filter_status", "filtered"), ("c4_filter_reasons", rs)],
-                        extra={"rewrite": False},
-                    ),
-                    False,
-                )
-            n_sent = int(g("n_sentences"))
-            n_lines = int(g("n_lines"))
-            keep_mask = np.asarray(stats[f"{idx}:line_keep"][row][:n_lines])
-            line_stats = []
+        any_cond = np.zeros(len(n_words), dtype=bool)
+        for cond, _ in checks:
+            any_cond |= cond
+        passed = ~any_cond
+
+        def decide(row: int, doc: TextDocument) -> _Decision:
+            rs = "; ".join(fn(row) for cond, fn in checks if cond[row])
+            return _Decision(
+                False,
+                rs,
+                [
+                    ("gopher_quality_filter_status", "filtered"),
+                    ("gopher_quality_filter_reasons", rs),
+                ],
+            )
+
+        return _StepEval(
+            passed=passed,
+            decide=decide,
+            pass_stamps=(("gopher_quality_filter_status", "passed"),),
+        )
+
+    def _eval_c4(self, step: StepConfig, idx: int, stats) -> "_StepEval":
+        p = step.params
+        overflow = np.asarray(stats[f"{idx}:line_overflow"], dtype=bool)
+        lorem = np.asarray(stats[f"{idx}:has_lorem"], dtype=bool)
+        curly = np.asarray(stats[f"{idx}:has_curly"], dtype=bool)
+        early = lorem | curly
+        n_sent = np.asarray(stats[f"{idx}:n_sentences"]).astype(np.int64)
+        n_lines = np.asarray(stats[f"{idx}:n_lines"]).astype(np.int64)
+        line_keep = np.asarray(stats[f"{idx}:line_keep"])
+        drops = [
+            (np.asarray(stats[f"{idx}:{key}"]).astype(np.int64), name)
             for key, name in (
                 ("drop_too_long", "line-filter-too_long_word"),
                 ("drop_no_term", "line-filter-no_terminal_punc"),
                 ("drop_few_words", "line-filter-too_few_words"),
-            ):
-                c = int(g(key))
-                if c > 0:
-                    line_stats.append((name, str(c)))
-            extra = {"rewrite": True, "keep_mask": keep_mask}
-            if p.min_num_sentences > 0 and n_sent < p.min_num_sentences:
-                rs = (
-                    f"too_few_sentences (found {n_sent}, "
-                    f"required {p.min_num_sentences})"
+            )
+        ]
+        few_sent = (
+            (n_sent < p.min_num_sentences)
+            if p.min_num_sentences > 0
+            else np.zeros(len(n_sent), dtype=bool)
+        )
+        passed = ~early & ~few_sent
+
+        def decide(row: int, doc: TextDocument) -> _Decision:
+            if early[row]:
+                reasons = []
+                if lorem[row]:
+                    reasons.append("lorem_ipsum")
+                if curly[row]:
+                    reasons.append("curly_bracket")
+                rs = "; ".join(reasons)
+                return _Decision(
+                    False,
+                    rs,
+                    [("c4_filter_status", "filtered"), ("c4_filter_reasons", rs)],
+                    extra={"rewrite": False},
                 )
-                stamps = [
-                    ("c4_filter_status", "filtered"),
-                    ("c4_filter_reasons", rs),
-                ] + line_stats
-                return _Decision(False, rs, stamps, extra=extra), False
-            return (
-                _Decision(True, stamps=[("c4_filter_status", "passed")], extra=extra),
+            rs = (
+                f"too_few_sentences (found {n_sent[row]}, "
+                f"required {p.min_num_sentences})"
+            )
+            stamps = [("c4_filter_status", "filtered"), ("c4_filter_reasons", rs)]
+            stamps += [(name, str(c[row])) for c, name in drops if c[row] > 0]
+            return _Decision(
                 False,
+                rs,
+                stamps,
+                extra={"rewrite": True, "keep_mask": line_keep[row][: n_lines[row]]},
             )
 
-        if step.type == "C4BadWordsFilter":
+        ev = _StepEval(
+            passed=passed,
+            overflow=overflow,
+            decide=decide,
+            pass_stamps=(("c4_filter_status", "passed"),),
+        )
+        ev.c4_line_keep = line_keep
+        ev.c4_n_lines = n_lines
+        return ev
+
+    def _eval_badwords(self, step: StepConfig, idx: int, stats) -> "_StepEval":
+        p = step.params
+        candidate = np.asarray(stats[f"{idx}:candidate"], dtype=bool)
+
+        def decide(row: int, doc: TextDocument) -> _Decision:
             # The device kernel only prefilters: candidate docs (and docs
             # whose metadata selects a different language than the compiled
-            # tables) run the real host filter — the regex scan is skipped for
-            # clean documents (c4_filters.rs:456-552).  Final decisions match
-            # a pure host run: the regex decides matches, and seeded
+            # tables) run the real host filter — the regex scan is skipped
+            # for clean documents (c4_filters.rs:456-552).  Final decisions
+            # match a pure host run: the regex decides matches, and seeded
             # keep-fraction draws are per-document (hash of seed + doc id),
             # independent of which docs reached the host step or in what
             # order (filters/c4_badwords.py RNG parity note).
-            doc_lang = doc.metadata.get("language", p.default_language)
-            if doc_lang == p.default_language and not bool(g("candidate")):
-                return (
-                    _Decision(True, stamps=[("c4_badwords_filter_status", "passed")]),
-                    False,
-                )
             from ..errors import DocumentFiltered
 
             host_step = self._badwords_host_step(idx)
             try:
                 host_step.process(doc)  # stamps metadata itself
             except DocumentFiltered as e:
-                return _Decision(False, e.reason), False
-            return _Decision(True), False
+                return _Decision(False, e.reason)
+            return _Decision(True)
 
-        if step.type == "FineWebQualityFilter":
-            if bool(g("line_overflow")):
-                return _Decision(True), True
-            n_lines = int(g("n_nonblank_lines"))
+        ev = _StepEval(passed=~candidate, decide=decide, pass_stamps=None)
+        ev.badwords_candidate = candidate
+        ev.badwords_default_language = p.default_language
+        return ev
 
+    def _eval_fineweb(self, step: StepConfig, idx: int, stats) -> "_StepEval":
+        p = step.params
+        overflow = np.asarray(stats[f"{idx}:line_overflow"], dtype=bool)
+        g = lambda key: np.asarray(stats[f"{idx}:{key}"]).astype(np.int64)  # noqa: E731
+        n_lines = g("n_nonblank_lines")
+        empty = n_lines == 0
+        nl_f = np.maximum(n_lines, 1).astype(np.float64)
+        punct_ratio = g("lines_ending_stop") / nl_f
+        punct_fail = (punct_ratio < p.line_punct_thr) & ~(
+            (punct_ratio == 0.0) & p.line_punct_exclude_zero
+        )
+        short_ratio = g("short_lines") / nl_f
+        short_fail = short_ratio > p.short_line_thr
+        total_chars = g("total_chars_no_newline")
+        dup_ratio = np.zeros(len(n_lines), dtype=np.float64)
+        np.divide(g("dup_line_bytes"), total_chars, out=dup_ratio, where=total_chars > 0)
+        dup_fail = dup_ratio > p.char_duplicates_ratio
+        n_words = g("n_words")
+        newlines = g("newline_count")
+        list_ratio = np.zeros(len(n_lines), dtype=np.float64)
+        np.divide(newlines, n_words, out=list_ratio, where=n_words > 0)
+        list_fail = np.where(
+            n_words == 0, newlines > 0, list_ratio > p.new_line_ratio
+        )
+        passed = ~(empty | punct_fail | short_fail | dup_fail | list_fail)
+
+        def decide(row: int, doc: TextDocument) -> _Decision:
             def fail(reason, outcome_reason=""):
-                return (
-                    _Decision(
-                        False,
-                        outcome_reason or reason,
-                        [
-                            ("fineweb_filter_status", "filtered"),
-                            ("fineweb_filter_reason", reason),
-                        ],
-                    ),
+                return _Decision(
                     False,
+                    outcome_reason or reason,
+                    [
+                        ("fineweb_filter_status", "filtered"),
+                        ("fineweb_filter_reason", reason),
+                    ],
                 )
 
-            if n_lines == 0:
+            # First failing check wins (fineweb_quality.rs check order).
+            if empty[row]:
                 return fail("empty document", outcome_reason="empty")
-            ratio = int(g("lines_ending_stop")) / n_lines
-            if ratio < p.line_punct_thr and not (
-                ratio == 0.0 and p.line_punct_exclude_zero
-            ):
+            if punct_fail[row]:
                 return fail(
-                    f"line_punct_ratio: {fmt4(ratio)} < threshold "
+                    f"line_punct_ratio: {fmt4(punct_ratio[row])} < threshold "
                     f"{fmt4(p.line_punct_thr)} (exclude_zero: "
                     f"{rust_bool(p.line_punct_exclude_zero)})"
                 )
-            ratio = int(g("short_lines")) / n_lines
-            if ratio > p.short_line_thr:
+            if short_fail[row]:
                 return fail(
-                    f"short_line_ratio: {fmt4(ratio)} > threshold "
+                    f"short_line_ratio: {fmt4(short_ratio[row])} > threshold "
                     f"{fmt4(p.short_line_thr)}"
                 )
-            total_chars = int(g("total_chars_no_newline"))
-            dup_ratio = (
-                int(g("dup_line_bytes")) / total_chars if total_chars > 0 else 0.0
-            )
-            if dup_ratio > p.char_duplicates_ratio:
+            if dup_fail[row]:
                 return fail(
-                    f"char_dup_ratio: {fmt4(dup_ratio)} > threshold "
+                    f"char_dup_ratio: {fmt4(dup_ratio[row])} > threshold "
                     f"{fmt4(p.char_duplicates_ratio)}"
                 )
-            n_words = int(g("n_words"))
-            newlines = int(g("newline_count"))
-            if n_words == 0:
-                if newlines > 0:
-                    return fail("list_ratio_no_words (newlines present but no words)")
-            else:
-                ratio = newlines / n_words
-                if ratio > p.new_line_ratio:
-                    return fail(
-                        f"list_ratio: {fmt4(ratio)} > threshold "
-                        f"{fmt4(p.new_line_ratio)}"
-                    )
-            return _Decision(True), False
+            if n_words[row] == 0:
+                return fail("list_ratio_no_words (newlines present but no words)")
+            return fail(
+                f"list_ratio: {fmt4(list_ratio[row])} > threshold "
+                f"{fmt4(p.new_line_ratio)}"
+            )
 
-        raise PipelineError(f"no finalizer for step {step.type}")
+        return _StepEval(passed=passed, overflow=overflow, decide=decide, pass_stamps=())
 
     # --- batch processing ---------------------------------------------------
 
@@ -718,13 +822,20 @@ class CompiledPipeline:
         """Apply the device line-keep mask to rebuild C4's rewritten content —
         the string half of c4_filters.rs:192-258; decisions came from device."""
         lines = rust_lines(doc.content)
-        kept = []
-        for i, line in enumerate(lines):
-            if i < len(keep_mask) and keep_mask[i]:
-                s = line.strip()
-                if step.params.remove_citations:
-                    s = CITATION_RE.sub("", s)
-                kept.append(s)
+        n = len(keep_mask)
+        if step.params.remove_citations:
+            # CITATION_RE can only match where a '[' exists — skip the regex
+            # for the (overwhelmingly common) bracket-free lines.
+            kept = [
+                CITATION_RE.sub("", s) if "[" in s else s
+                for i, line in enumerate(lines)
+                if i < n and keep_mask[i]
+                for s in (line.strip(),)
+            ]
+        else:
+            kept = [
+                line.strip() for i, line in enumerate(lines) if i < n and keep_mask[i]
+            ]
         doc.content = "\n".join(kept).strip()
 
     def dispatch_batch(self, batch: PackedBatch) -> Dict[str, jax.Array]:
@@ -755,17 +866,21 @@ class CompiledPipeline:
         # on the PRISTINE document (no device-side stamps/rewrites applied
         # yet), so fallback outcomes are bit-identical to a pure host run.
         n_rows = len(batch.docs)
+        evals = [
+            self._eval_step(step, idx, stats)
+            for idx, step in enumerate(self.device_steps)
+        ]
         overflow_any = np.zeros(n_rows, dtype=bool)
-        for key, v in stats.items():
-            if key.endswith(("seg_overflow", "word_overflow", "line_overflow")):
-                overflow_any |= np.asarray(v[:n_rows], dtype=bool)
+        for ev in evals:
+            if ev.overflow is not None:
+                overflow_any |= ev.overflow[:n_rows]
         outcomes: List[ProcessingOutcome] = []
         for row, doc in enumerate(batch.docs):
             if overflow_any[row]:
                 METRICS.inc("worker_host_fallback_total")
                 outcome = execute_processing_pipeline(self.host_executor, doc)
             else:
-                outcome = self._assemble(stats, row, doc)
+                outcome = self._assemble(evals, row, doc)
             if outcome is not None:  # hard error -> no outcome (reference quirk)
                 outcomes.append(outcome)
         return outcomes
@@ -773,17 +888,33 @@ class CompiledPipeline:
     def process_batch(self, batch: PackedBatch) -> List[ProcessingOutcome]:
         return self.assemble_batch(batch, self.dispatch_batch(batch))
 
+    _BADWORDS_PASS_STAMPS = (("c4_badwords_filter_status", "passed"),)
+
     def _assemble(
-        self, stats: Dict[str, np.ndarray], row: int, doc: TextDocument
+        self, evals: List[_StepEval], row: int, doc: TextDocument
     ) -> ProcessingOutcome:
-        for idx, step in enumerate(self.device_steps):
-            decision, overflowed = self._finalize_step(step, idx, stats, row, doc)
-            if overflowed:
-                # Table overflow: this doc is an outlier — host oracle rerun.
-                return execute_processing_pipeline(self.host_executor, doc)
+        for step, ev in zip(self.device_steps, evals):
+            if ev.badwords_default_language is not None:
+                # Fast path only for non-candidate docs whose metadata selects
+                # the compiled tables' language; everything else runs the real
+                # host filter inside decide().
+                doc_lang = doc.metadata.get("language", ev.badwords_default_language)
+                if doc_lang == ev.badwords_default_language and not ev.badwords_candidate[row]:
+                    for k, v in self._BADWORDS_PASS_STAMPS:
+                        doc.metadata[k] = v
+                    continue
+            elif ev.passed[row] and ev.pass_stamps is not None:
+                for k, v in ev.pass_stamps:
+                    doc.metadata[k] = v
+                if ev.c4_line_keep is not None:
+                    self._rewrite_c4(
+                        doc, step, ev.c4_line_keep[row][: ev.c4_n_lines[row]]
+                    )
+                continue
+            decision = ev.decide(row, doc)
             for k, v in decision.stamps:
                 doc.metadata[k] = v
-            if step.type == "C4QualityFilter" and decision.extra is not None:
+            if ev.c4_line_keep is not None and decision.extra is not None:
                 if decision.extra.get("rewrite"):
                     self._rewrite_c4(doc, step, decision.extra["keep_mask"])
             if not decision.passed:
@@ -791,6 +922,16 @@ class CompiledPipeline:
         if self.host_steps:
             return execute_processing_pipeline(self.host_suffix_executor, doc)
         return ProcessingOutcome.success(doc)
+
+
+_EVALS = {
+    "LanguageDetectionFilter": CompiledPipeline._eval_langid,
+    "GopherRepetitionFilter": CompiledPipeline._eval_gopher_rep,
+    "GopherQualityFilter": CompiledPipeline._eval_gopher_quality,
+    "C4QualityFilter": CompiledPipeline._eval_c4,
+    "C4BadWordsFilter": CompiledPipeline._eval_badwords,
+    "FineWebQualityFilter": CompiledPipeline._eval_fineweb,
+}
 
 
 def process_documents_device(
