@@ -277,6 +277,13 @@ def main() -> int:
 
     platform, probe_failures = _resolve_platform()
     _log(f"platform: {platform}")
+    if platform == "cpu":
+        # Fallback mode must be hang-proof: drop the remote plugin's backend
+        # factory so a sick tunnel cannot stall first backend init (the exact
+        # failure this fallback exists to survive).
+        from textblaster_tpu.utils.backend_guard import force_cpu_backend
+
+        force_cpu_backend()
     import jax
 
     jax.config.update("jax_platforms", platform)

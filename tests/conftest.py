@@ -19,11 +19,14 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# CPU-only, hang-proof: the baked remote-TPU plugin otherwise initializes on
+# first backend use and can block the whole suite while the remote chip is
+# claimed elsewhere (see utils/backend_guard.py).
+from textblaster_tpu.utils.backend_guard import force_cpu_backend  # noqa: E402
+
+force_cpu_backend()
 
 # Persistent compilation cache: the filter-pipeline graphs are large, and the
 # suite re-jits them every session without this.

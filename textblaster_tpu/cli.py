@@ -49,8 +49,11 @@ def build_parser() -> argparse.ArgumentParser:
                      help="Path to the output Parquet file")
     run.add_argument("-e", "--excluded-file", default="excluded.parquet",
                      help="Path to the excluded output Parquet file")
-    run.add_argument("--backend", choices=("host", "tpu"), default="tpu",
-                     help="Execution backend: compiled TPU pipeline or host oracle")
+    run.add_argument("--backend", choices=("host", "tpu", "cpu"), default="tpu",
+                     help="Execution backend: compiled pipeline on the "
+                          "accelerator (tpu), the same compiled pipeline "
+                          "pinned to the local CPU backend (cpu — immune to "
+                          "remote-chip outages), or the host oracle (host)")
     run.add_argument("--batch-size", type=int, default=1024,
                      help="Parquet read batch size")
     run.add_argument("--device-batch", type=int, default=None,
@@ -91,6 +94,15 @@ def _cmd_validate(args: argparse.Namespace) -> int:
 def _cmd_run(args: argparse.Namespace) -> int:
     init_logging("textblast")
     setup_prometheus_metrics(args.metrics_port)
+
+    if args.backend == "cpu":
+        # Compiled pipeline pinned to the in-process CPU backend; drops any
+        # remote plugin factory so a dead tunnel cannot hang the run
+        # (utils/backend_guard.py).
+        from .utils.backend_guard import force_cpu_backend
+
+        force_cpu_backend()
+        args.backend = "tpu"
 
     if args.backend == "tpu":
         # Large traced pipelines + (possibly remote) TPU compiles: persist

@@ -101,22 +101,24 @@ def utf8_width(cps: jax.Array) -> jax.Array:
     return w.astype(jnp.int32)
 
 
-def isin_sorted(cps: jax.Array, sorted_vals: jax.Array) -> jax.Array:
+def isin_sorted(cps: jax.Array, sorted_vals) -> jax.Array:
     """Membership of each element in a small sorted codepoint set."""
+    sorted_vals = jnp.asarray(sorted_vals)
     idx = jnp.searchsorted(sorted_vals, cps)
     idx = jnp.minimum(idx, sorted_vals.shape[0] - 1)
     return sorted_vals[idx] == cps
 
 
-MID_LETTER_CPS = jnp.asarray(
-    np.sort(np.array([ord(c) for c in (_MID_LETTER | _MID_NUM_LET)], dtype=np.int32))
+# Plain numpy at module scope: a jnp.asarray here would initialize a JAX
+# backend at import time (observed hanging the whole process when the remote
+# axon chip is claimed by another process).  jnp converts these per trace.
+MID_LETTER_CPS = np.sort(
+    np.array([ord(c) for c in (_MID_LETTER | _MID_NUM_LET)], dtype=np.int32)
 )
-MID_NUM_CPS = jnp.asarray(
-    np.sort(np.array([ord(c) for c in (_MID_NUM | _MID_NUM_LET)], dtype=np.int32))
+MID_NUM_CPS = np.sort(
+    np.array([ord(c) for c in (_MID_NUM | _MID_NUM_LET)], dtype=np.int32)
 )
-MID_ALL_CPS = jnp.asarray(
-    np.sort(np.array([ord(c) for c in _MID_ALL], dtype=np.int32))
-)
+MID_ALL_CPS = np.sort(np.array([ord(c) for c in _MID_ALL], dtype=np.int32))
 
 
 # --- Segmented scans ---------------------------------------------------------

@@ -1,0 +1,76 @@
+"""Hang-proof CPU-only mode for processes that must never touch a remote chip.
+
+This image injects a remote-TPU PJRT plugin via ``sitecustomize`` (registered
+at interpreter start, before any project code runs).  JAX initializes every
+*registered* platform on first backend use even when ``JAX_PLATFORMS=cpu``
+selects only the CPU — and the remote plugin's init dials a relay that can
+block indefinitely while the chip is claimed by another process or the tunnel
+is down.  Observed effects: ``jax.devices()`` hanging >15 min in CPU-only
+test runs, and the benchmark's CPU fallback path dying with the same hang it
+was meant to survive.
+
+:func:`force_cpu_backend` drops every non-CPU backend factory before first
+initialization, so the process provably cannot dial out.  Call it before any
+JAX computation in processes that are CPU-by-contract (the test suite, the
+benchmark's fallback mode, the virtual-mesh dryrun).
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["force_cpu_backend"]
+
+
+def force_cpu_backend() -> None:
+    """Restrict this process to the in-process CPU backend, irreversibly.
+
+    Safe to call multiple times; a no-op once backends are initialized (at
+    that point either the remote platform already came up or we are past the
+    risk of a first-init hang).
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        from jax._src import xla_bridge as xb
+
+        if not xb.backends_are_initialized():
+            for name, reg in list(xb._backend_factories.items()):
+                if name == "cpu":
+                    continue
+
+                def _refuse(*args, _name=name, **kwargs):
+                    raise RuntimeError(
+                        f"backend {_name!r} disabled by force_cpu_backend()"
+                    )
+
+                # Keep the platform REGISTERED (popping it breaks MLIR's
+                # known-platform validation for tpu lowering rules) but make
+                # its init fail fast and quietly instead of dialing out.
+                xb._backend_factories[name] = _registration_like(
+                    reg, factory=_refuse
+                )
+    except Exception as e:  # noqa: BLE001 — private API may drift across jax versions
+        import logging
+
+        # Degraded to env-var-only protection, which does NOT prevent the
+        # remote plugin's first-init hang — make the regression diagnosable.
+        logging.getLogger(__name__).warning(
+            "backend_guard could not patch jax backend factories (%s: %s); "
+            "remote-plugin init hangs are possible again",
+            type(e).__name__,
+            e,
+        )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _registration_like(reg, factory):
+    """A copy of a BackendRegistration with the factory swapped and failures
+    made quiet, tolerant of NamedTuple vs dataclass across jax versions."""
+    try:
+        return reg._replace(factory=factory, fail_quietly=True)
+    except AttributeError:
+        import dataclasses
+
+        return dataclasses.replace(reg, factory=factory, fail_quietly=True)
