@@ -28,6 +28,13 @@ from textblaster_tpu.utils.backend_guard import force_cpu_backend  # noqa: E402
 
 force_cpu_backend()
 
+# Keep every document on the DEVICE path in tests: the runtime's host-oracle
+# tail routing (ops/pipeline.py process_chunk) would otherwise hand small
+# end-of-stream groups to the host executor, quietly turning parts of the
+# parity suites into host-vs-host comparisons.  test_packing's dedicated
+# tail-routing tests re-enable it locally.
+os.environ.setdefault("TEXTBLAST_HOST_TAILS", "off")
+
 # Persistent compilation cache: the filter-pipeline graphs are large, and the
 # suite re-jits them every session without this.
 from textblaster_tpu.utils.compile_cache import enable_compilation_cache  # noqa: E402

@@ -1,0 +1,85 @@
+"""Host-oracle tail routing (ops/pipeline.py process_chunk).
+
+End-of-stream leftover groups below the per-phase threshold go to the host
+oracle instead of a padded device batch.  The host path is bit-exact, so
+outcomes must be identical either way; what these tests pin down is the
+routing itself and its accounting (worker_host_tail_total vs the overflow
+fallback counter) — the conftest disables tail routing suite-wide so the
+parity tests exercise device kernels for every doc, and these tests
+re-enable it locally.
+"""
+
+import numpy as np
+
+from textblaster_tpu.config.pipeline import parse_pipeline_config
+from textblaster_tpu.ops.pipeline import process_documents_device
+from textblaster_tpu.orchestration import process_documents_host
+from textblaster_tpu.pipeline_builder import build_pipeline_from_config
+from textblaster_tpu.data_model import TextDocument
+from textblaster_tpu.utils.metrics import METRICS
+
+# Three phases: boundaries after langid and after gopher_quality.
+_CONFIG = """
+pipeline:
+  - type: LanguageDetectionFilter
+    min_confidence: 0.1
+    allowed_languages: [ "dan", "eng" ]
+  - type: GopherQualityFilter
+    min_doc_words: 2
+    min_avg_word_length: 1.0
+    max_avg_word_length: 20.0
+    min_stop_words: 0
+  - type: FineWebQualityFilter
+    line_punct_thr: 0.0
+    line_punct_exclude_zero: false
+    short_line_thr: 1.0
+    short_line_length: 5
+    char_duplicates_ratio: 1.0
+    new_line_ratio: 1.0
+"""
+
+
+def _docs(n=19):
+    rng = np.random.default_rng(3)
+    words = "det er en god dag og vi skal ud at se solen over byen".split()
+    docs = []
+    for i in range(n):
+        k = int(rng.integers(8, 40))
+        text = " ".join(words[int(rng.integers(0, len(words)))] for _ in range(k))
+        docs.append(TextDocument(id=f"t{i}", source="s", content=text + "."))
+    return docs
+
+
+def _run_device(monkeypatch, host_tails: str):
+    monkeypatch.setenv("TEXTBLAST_HOST_TAILS", host_tails)
+    config = parse_pipeline_config(_CONFIG)
+    return list(
+        process_documents_device(config, iter(_docs()), device_batch=8)
+    )
+
+
+def test_tail_routing_counts_and_matches_host(monkeypatch):
+    config = parse_pipeline_config(_CONFIG)
+    host = {
+        o.document.id: (o.kind, o.reason)
+        for o in process_documents_host(build_pipeline_from_config(config), iter(_docs()))
+    }
+
+    tails0 = METRICS.get("worker_host_tail_total")
+    fb0 = METRICS.get("worker_host_fallback_total")
+    outcomes = _run_device(monkeypatch, "on")
+    assert METRICS.get("worker_host_tail_total") > tails0  # routing happened
+    assert METRICS.get("worker_host_fallback_total") == fb0  # not conflated
+    assert {o.document.id: (o.kind, o.reason) for o in outcomes} == host
+
+
+def test_tail_routing_disabled_keeps_docs_on_device(monkeypatch):
+    config = parse_pipeline_config(_CONFIG)
+    host = {
+        o.document.id: (o.kind, o.reason)
+        for o in process_documents_host(build_pipeline_from_config(config), iter(_docs()))
+    }
+    tails0 = METRICS.get("worker_host_tail_total")
+    outcomes = _run_device(monkeypatch, "off")
+    assert METRICS.get("worker_host_tail_total") == tails0
+    assert {o.document.id: (o.kind, o.reason) for o in outcomes} == host

@@ -123,7 +123,21 @@ MID_ALL_CPS = np.sort(np.array([ord(c) for c in _MID_ALL], dtype=np.int32))
 
 # --- Segmented scans ---------------------------------------------------------
 # State (v, r): r = "resets here".  Composition is the standard segmented-scan
-# monoid; associative, so lax.associative_scan applies.
+# monoid; associative, so any scan schedule computes the same values.
+#
+# Two schedules are provided:
+#
+# * ``assoc`` — ``jax.lax.associative_scan`` (work-efficient odd/even
+#   recursion).  Its stride-2 slices relayout on TPU's tiled [sublane, lane]
+#   layouts, which makes each of the log L levels far more expensive than its
+#   FLOPs suggest.
+# * ``shift`` — Hillis-Steele doubling: level ``d`` combines position ``i``
+#   with ``i - d`` via a pad+slice shift (contiguous, layout-preserving).
+#   O(L log L) work instead of O(L), but every step is a cheap contiguous
+#   move — the TPU-friendly schedule.
+#
+# ``TEXTBLAST_SCAN_IMPL`` (assoc|shift) pins one; default picks by backend at
+# trace time (shift on tpu-like backends, assoc elsewhere).
 
 
 def _seg_add_op(a, b):
@@ -144,20 +158,90 @@ def _seg_max_op(a, b):
     return jnp.where(br, bv, jnp.maximum(av, bv)), ar | br
 
 
+def _use_shift_scan() -> bool:
+    import os
+
+    impl = os.environ.get("TEXTBLAST_SCAN_IMPL", "")
+    if impl == "shift":
+        return True
+    if impl == "assoc":
+        return False
+    return jax.default_backend() in ("tpu", "axon")
+
+
+def shift_scan_tuple(op, identities, xs, axis: int = 1):
+    """Inclusive scan of a TUPLE state under associative ``op`` via the
+    contiguous-shift (Hillis-Steele) schedule.
+
+    ``op`` maps ``(left_state, right_state)`` tuples to a state tuple, where
+    the left operand is the earlier prefix.  ``identities`` gives ``op``'s
+    identity per component: a scalar, or an array broadcastable to a
+    ``[B, d, ...]`` pad block.  The one scan-schedule implementation shared
+    by the segmented scans, :func:`assoc_scan1`, and the fused polynomial
+    hashes (stats._poly_hash_many).
+    """
+    if axis != 1:
+        xs = tuple(jnp.moveaxis(x, axis, 1) for x in xs)
+    length = xs[0].shape[1]
+
+    def pad_block(x, ident, d):
+        blk = x[:, :d]
+        if isinstance(ident, (int, bool, np.integer, np.bool_)):
+            pad = jnp.full_like(blk, ident)
+        else:
+            pad = jnp.broadcast_to(ident, blk.shape).astype(x.dtype)
+        return jnp.concatenate([pad, x[:, :-d]], axis=1)
+
+    d = 1
+    while d < length:
+        shifted = tuple(
+            pad_block(x, ident, d) for x, ident in zip(xs, identities)
+        )
+        xs = op(shifted, xs)
+        d *= 2
+    if axis != 1:
+        xs = tuple(jnp.moveaxis(x, 1, axis) for x in xs)
+    return xs
+
+
+def _seg_scan(op, identity, values: jax.Array, reset: jax.Array, axis: int):
+    if _use_shift_scan():
+        # Virtual elements left of position 0 are (op identity, reset=True):
+        # the identity keeps in-range prefixes exact, the True seals the
+        # boundary for later levels.
+        v, _ = shift_scan_tuple(op, (identity, True), (values, reset), axis)
+        return v
+    out, _ = jax.lax.associative_scan(op, (values, reset), axis=axis)
+    return out
+
+
+def assoc_scan1(op, identity, x: jax.Array, axis: int = 1) -> jax.Array:
+    """Inclusive scan of a single array under an arbitrary associative ``op``,
+    using the backend-appropriate schedule (see scan notes above).
+
+    ``identity`` is ``op``'s identity: a scalar, or an array broadcastable to
+    a ``[B, d, ...]`` pad block (e.g. an iota for function-composition scans).
+    """
+    if not _use_shift_scan():
+        return jax.lax.associative_scan(op, x, axis=axis)
+
+    def tuple_op(a, b):
+        return (op(a[0], b[0]),)
+
+    return shift_scan_tuple(tuple_op, (identity,), (x,), axis)[0]
+
+
 def seg_scan_add(values: jax.Array, reset: jax.Array, axis: int = 1) -> jax.Array:
     """Inclusive segmented sum along ``axis``; ``reset[i]`` starts a segment."""
-    out, _ = jax.lax.associative_scan(_seg_add_op, (values, reset), axis=axis)
-    return out
+    return _seg_scan(_seg_add_op, 0, values, reset, axis)
 
 
 def seg_scan_or(values: jax.Array, reset: jax.Array, axis: int = 1) -> jax.Array:
-    out, _ = jax.lax.associative_scan(_seg_or_op, (values, reset), axis=axis)
-    return out
+    return _seg_scan(_seg_or_op, 0, values, reset, axis)
 
 
 def seg_scan_max(values: jax.Array, reset: jax.Array, axis: int = 1) -> jax.Array:
-    out, _ = jax.lax.associative_scan(_seg_max_op, (values, reset), axis=axis)
-    return out
+    return _seg_scan(_seg_max_op, np.iinfo(np.int32).min, values, reset, axis)
 
 
 def rev(x: jax.Array, axis: int = 1) -> jax.Array:

@@ -14,6 +14,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .device import assoc_scan1
+
 __all__ = ["dfa_states", "citation_spans"]
 
 
@@ -51,7 +53,11 @@ def dfa_states(
                 out = out | (((b >> (nib << 2)) & 15) << (4 * s))
             return out
 
-        packed = jax.lax.associative_scan(compose, fns, axis=1)
+        # Identity function map: nibble s holds s.
+        ident = 0
+        for s in range(n_states):
+            ident |= s << (4 * s)
+        packed = assoc_scan1(compose, np.int32(ident), fns, axis=1)
         return (packed >> (4 * start_state)) & 15
 
     table = jnp.asarray(transition, dtype=jnp.int32)  # [S, N]
@@ -62,7 +68,9 @@ def dfa_states(
         # Apply a then b: (b . a)(s) = b[a[s]].
         return jnp.take_along_axis(b, a, axis=-1)
 
-    composed = jax.lax.associative_scan(compose, fns, axis=1)
+    composed = assoc_scan1(
+        compose, jnp.arange(transition.shape[1], dtype=jnp.int32), fns, axis=1
+    )
     return composed[..., start_state]
 
 
@@ -110,7 +118,7 @@ def citation_spans(cps: jax.Array, digit_mask: jax.Array, ws_mask: jax.Array) ->
     # match opener).  Mark spans with a +1/-1 difference array and a cumsum.
     positions = jnp.arange(cps.shape[1], dtype=jnp.int32)[None, :]
     lb_pos = jnp.where(cps == ord("["), positions, -1)
-    last_lb = jax.lax.associative_scan(jnp.maximum, lb_pos, axis=1)
+    last_lb = assoc_scan1(jnp.maximum, np.int32(-1), lb_pos, axis=1)
 
     b, length = cps.shape
     rows = jnp.arange(b, dtype=jnp.int32)[:, None]

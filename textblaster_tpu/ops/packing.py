@@ -100,26 +100,28 @@ def iter_packed_batches(
     docs: Iterator[TextDocument],
     batch_size: int = 256,
     buckets: Sequence[int] = DEFAULT_BUCKETS,
+    host_tail_max: int = 0,
 ) -> Iterator[Tuple[Optional[PackedBatch], List[TextDocument]]]:
     """Group a document stream into per-bucket batches.
 
     Yields ``(packed_batch, host_fallback_docs)`` pairs.  Documents longer
     than the largest bucket are returned in the fallback list (processed by
     the host oracle); everything else lands in the smallest bucket that fits.
-    A final partial batch per bucket is flushed at stream end.
+
+    End-of-stream handling: a device program computes every padded row, so
+    per-bucket partial flushes waste most of their cost.  Leftovers from all
+    buckets are merged (sorted by length), split into ``batch_size`` groups,
+    and each group is packed at the smallest bucket that fits its longest
+    document — one near-full batch instead of several near-empty ones.
+    Groups of at most ``host_tail_max`` documents are handed back as
+    fallback docs: below that size the (bit-exact) host oracle is cheaper
+    than any padded device batch.
     """
     buckets = tuple(sorted(buckets))
     margin = PACK_MARGIN
     largest = buckets[-1] - margin
     pending: dict[int, List[TextDocument]] = {b: [] for b in buckets}
     overflow: List[TextDocument] = []
-
-    def flush(bucket: int) -> Optional[PackedBatch]:
-        batch_docs = pending[bucket]
-        if not batch_docs:
-            return None
-        pending[bucket] = []
-        return pack_documents(batch_docs, batch_size=batch_size, max_len=bucket)
 
     for doc in docs:
         n_chars = len(doc.content)
@@ -133,12 +135,22 @@ def iter_packed_batches(
             if n_chars <= b - margin:
                 pending[b].append(doc)
                 if len(pending[b]) >= batch_size:
-                    yield flush(b), []
+                    batch_docs, pending[b] = pending[b], []
+                    yield pack_documents(
+                        batch_docs, batch_size=batch_size, max_len=b
+                    ), []
                 break
 
-    for b in buckets:
-        batch = flush(b)
-        if batch is not None:
-            yield batch, []
+    leftovers = [d for b in buckets for d in pending[b]]
+    leftovers.sort(key=lambda d: len(d.content))
+    for i in range(0, len(leftovers), batch_size):
+        group = leftovers[i : i + batch_size]
+        if len(group) <= host_tail_max:
+            yield None, group
+            continue
+        need = next(
+            b for b in buckets if len(group[-1].content) <= b - margin
+        )
+        yield pack_documents(group, batch_size=batch_size, max_len=need), []
     if overflow:
         yield None, overflow

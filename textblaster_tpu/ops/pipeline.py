@@ -42,7 +42,7 @@ from ..pipeline_builder import build_pipeline_from_config
 from ..utils.metrics import METRICS
 from .badwords import badwords_candidates
 from .langid_tpu import langid_scores
-from .packing import DEFAULT_BUCKETS, PackedBatch, iter_packed_batches
+from .packing import DEFAULT_BUCKETS, PACK_MARGIN, PackedBatch, iter_packed_batches
 from .stats import (
     C4Params,
     c4_stage,
@@ -182,6 +182,26 @@ class _StepEval:
         self.badwords_default_language = None
 
 
+# Step types that cheaply kill many documents: a phase boundary after them
+# lets the runner repack survivors and skip the expensive downstream kernels
+# for already-filtered rows — the device analogue of the host executor's
+# short-circuit (executor.rs:30-57).
+_PHASE_BOUNDARY_AFTER = frozenset({"LanguageDetectionFilter", "GopherQualityFilter"})
+
+
+def _split_phases(steps: List[StepConfig]) -> List[List[int]]:
+    phases: List[List[int]] = []
+    cur: List[int] = []
+    for i, s in enumerate(steps):
+        cur.append(i)
+        if s.type in _PHASE_BOUNDARY_AFTER and i < len(steps) - 1:
+            phases.append(cur)
+            cur = []
+    if cur:
+        phases.append(cur)
+    return phases or [[]]
+
+
 class CompiledPipeline:
     """A pipeline config compiled for device execution."""
 
@@ -221,9 +241,20 @@ class CompiledPipeline:
         # Host-only fallback when un-kerneled steps precede device steps.
         self.fully_host = any(_step_on_device(s) for s in self.host_steps)
 
+        # Multi-phase short-circuiting only for single-controller runs: a
+        # multi-host SPMD job must dispatch identical programs in lockstep,
+        # and per-host survivor counts differ (parallel/multihost.py).
+        # TEXTBLAST_PHASES=off pins the single fused program.
+        import os as _os
+
+        if mesh is None and _os.environ.get("TEXTBLAST_PHASES") != "off":
+            self.phases = _split_phases(self.device_steps)
+        else:
+            self.phases = [list(range(len(self.device_steps)))]
+
         self._host_executor = None
         self._host_suffix_executor = None
-        self._jitted: Dict[int, Callable] = {}
+        self._jitted: Dict[Tuple[int, int], Callable] = {}
         self._badwords_steps: Dict[int, object] = {}
 
     def _badwords_host_step(self, idx: int):
@@ -256,10 +287,11 @@ class CompiledPipeline:
 
     # --- device program -----------------------------------------------------
 
-    def _build_fn(self, length: int) -> Callable:
+    def _build_fn(self, length: int, phase: int = 0) -> Callable:
         max_lines, max_words = _table_sizes(length)
         plans = []
-        for i, step in enumerate(self.device_steps):
+        for i in self.phases[phase]:
+            step = self.device_steps[i]
             p = step.params
             if step.type == "LanguageDetectionFilter":
                 plans.append(("langid", i, None))
@@ -314,13 +346,21 @@ class CompiledPipeline:
         # the stats entry points take the mesh explicitly (pallas_sort.sort2).
         mesh = self.mesh if self.mesh is not None and self.mesh.devices.size > 1 else None
 
+        # Unit hashes are consumed only by the Gopher steps; phases without
+        # them (e.g. the c4+fineweb phase) skip both polynomial-hash scans.
+        needs_hashes = any(
+            kind in ("gopher_quality", "gopher_rep") for kind, _, _ in plans
+        )
+
         def fn(cps, lengths):
             out: Dict[str, jax.Array] = {}
             state = {"cps": cps, "lengths": lengths, "st": None}
 
             def get_structure():
                 if state["st"] is None:
-                    state["st"] = structure(state["cps"], state["lengths"])
+                    state["st"] = structure(
+                        state["cps"], state["lengths"], with_hashes=needs_hashes
+                    )
                 return state["st"]
 
             return _eval_plans(plans, state, out, get_structure, max_lines, max_words)
@@ -385,10 +425,11 @@ class CompiledPipeline:
             )
         return jax.jit(fn)
 
-    def _fn_for(self, length: int) -> Callable:
-        if length not in self._jitted:
-            self._jitted[length] = self._build_fn(length)
-        return self._jitted[length]
+    def _fn_for(self, length: int, phase: int = 0) -> Callable:
+        key = (length, phase)
+        if key not in self._jitted:
+            self._jitted[key] = self._build_fn(length, phase)
+        return self._jitted[key]
 
     # --- host finalizers ----------------------------------------------------
     #
@@ -838,12 +879,14 @@ class CompiledPipeline:
             ]
         doc.content = "\n".join(kept).strip()
 
-    def dispatch_batch(self, batch: PackedBatch) -> Dict[str, jax.Array]:
+    def dispatch_batch(
+        self, batch: PackedBatch, phase: int = 0
+    ) -> Dict[str, jax.Array]:
         """Launch the compiled program for a batch and return the on-device
         stats WITHOUT blocking (JAX async dispatch) — the caller overlaps the
         previous batch's host-side assembly with this batch's device compute
         (the double-buffered feed SURVEY.md §2.5 maps prefetch/QoS onto)."""
-        fn = self._fn_for(batch.max_len)
+        fn = self._fn_for(batch.max_len, phase)
         if self.mesh is not None:
             from ..parallel.mesh import shard_batch
 
@@ -852,48 +895,159 @@ class CompiledPipeline:
             cps, lengths = batch.cps, batch.lengths
         return fn(cps, lengths)
 
-    def assemble_batch(
-        self, batch: PackedBatch, device_stats: Dict[str, jax.Array]
-    ) -> List[ProcessingOutcome]:
-        """Blocking half: transfer stats, resolve order/short-circuit/reason
-        strings per document."""
+    def assemble_phase(
+        self,
+        batch: PackedBatch,
+        device_stats: Dict[str, jax.Array],
+        phase: int = 0,
+    ) -> Tuple[List[ProcessingOutcome], List[TextDocument]]:
+        """Blocking half for one phase: transfer stats, resolve
+        order/short-circuit/reason strings per document.
+
+        Returns ``(outcomes, survivors)``: outcomes are final (filtered docs,
+        host-fallback reruns, and — on the last phase — passes); survivors
+        are documents that passed a non-final phase and continue to the next.
+        """
         # ONE bundled transfer: on the remote-tunnel TPU backend each per-key
         # np.asarray is its own synchronous round trip (~0.7s/key measured,
         # 48 keys = 35s/batch); jax.device_get moves the whole tree in one
         # call (93ms measured for the same batch).
         stats = jax.device_get(device_stats)
-        # Rows where any step hit a kernel table bound rerun the host oracle
-        # on the PRISTINE document (no device-side stamps/rewrites applied
-        # yet), so fallback outcomes are bit-identical to a pure host run.
+        # Rows where any step hit a kernel table bound rerun the host oracle.
+        # Phase-boundary note: a doc overflowing in a later phase carries the
+        # earlier phases' metadata stamps; the full-pipeline host rerun
+        # re-stamps the identical values (device/host stamp parity), so the
+        # outcome is still bit-identical to a pure host run.
         n_rows = len(batch.docs)
+        step_ids = self.phases[phase]
         evals = [
-            self._eval_step(step, idx, stats)
-            for idx, step in enumerate(self.device_steps)
+            (self.device_steps[i], self._eval_step(self.device_steps[i], i, stats))
+            for i in step_ids
         ]
         overflow_any = np.zeros(n_rows, dtype=bool)
-        for ev in evals:
+        for _, ev in evals:
             if ev.overflow is not None:
                 overflow_any |= ev.overflow[:n_rows]
+        last = phase == len(self.phases) - 1
         outcomes: List[ProcessingOutcome] = []
+        survivors: List[TextDocument] = []
         for row, doc in enumerate(batch.docs):
             if overflow_any[row]:
                 METRICS.inc("worker_host_fallback_total")
                 outcome = execute_processing_pipeline(self.host_executor, doc)
             else:
-                outcome = self._assemble(evals, row, doc)
+                outcome = self._assemble_row(evals, row, doc)
+                if outcome is None:  # passed every step of this phase
+                    if not last:
+                        survivors.append(doc)
+                        continue
+                    if self.host_steps:
+                        outcome = execute_processing_pipeline(
+                            self.host_suffix_executor, doc
+                        )
+                    else:
+                        outcome = ProcessingOutcome.success(doc)
             if outcome is not None:  # hard error -> no outcome (reference quirk)
                 outcomes.append(outcome)
+        return outcomes, survivors
+
+    def assemble_batch(
+        self, batch: PackedBatch, device_stats: Dict[str, jax.Array]
+    ) -> List[ProcessingOutcome]:
+        """Single-phase form (the multi-host lockstep path): every device
+        step evaluated from one program's stats."""
+        assert len(self.phases) == 1, "assemble_batch requires a single-phase pipeline"
+        outcomes, _ = self.assemble_phase(batch, device_stats, 0)
         return outcomes
 
     def process_batch(self, batch: PackedBatch) -> List[ProcessingOutcome]:
+        assert len(self.phases) == 1
         return self.assemble_batch(batch, self.dispatch_batch(batch))
+
+    def process_chunk(self, docs: List[TextDocument]) -> Iterator[ProcessingOutcome]:
+        """Run one chunk of documents through every phase, repacking the
+        survivors between phases (device-side short-circuit)."""
+        import os
+        import time
+
+        debug = os.environ.get("TEXTBLAST_PHASE_DEBUG") == "1"
+        current: List[TextDocument] = docs
+        for phase in range(len(self.phases)):
+            t0 = time.perf_counter()
+            t_dispatch = t_assemble = 0.0
+            n_in, n_batches = len(current), 0
+            survivors: List[TextDocument] = []
+            pending = None  # one batch in flight per phase
+            # Host-oracle threshold for leftover groups: the first phase's
+            # program is cheap (it exists to kill docs early), so the device
+            # wins even for small groups; later phases carry the expensive
+            # kernels and the (bit-exact) host oracle wins below ~half a
+            # batch.  Mesh runs keep every doc on device (shard accounting),
+            # and TEXTBLAST_HOST_TAILS=off pins tails to the device too (the
+            # parity suites use it so device kernels decide every doc).
+            if self.mesh is None and os.environ.get("TEXTBLAST_HOST_TAILS") != "off":
+                host_tail_max = (
+                    self.batch_size // 16 if phase == 0 else self.batch_size // 2
+                )
+            else:
+                host_tail_max = 0
+            over_length = self.buckets[-1] - PACK_MARGIN
+            for batch, fallback in iter_packed_batches(
+                iter(current),
+                batch_size=self.batch_size,
+                buckets=self.buckets,
+                host_tail_max=host_tail_max,
+            ):
+                if batch is not None:
+                    n_batches += 1
+                    td = time.perf_counter()
+                    stats = self.dispatch_batch(batch, phase)
+                    t_dispatch += time.perf_counter() - td
+                    if os.environ.get("TEXTBLAST_NO_OVERLAP") == "1":
+                        jax.block_until_ready(stats)
+                    if pending is not None:
+                        ta = time.perf_counter()
+                        outcomes, alive = self.assemble_phase(*pending)
+                        t_assemble += time.perf_counter() - ta
+                        survivors.extend(alive)
+                        yield from outcomes
+                    pending = (batch, stats, phase)
+                for doc in fallback:
+                    # Over-length docs are genuine fallbacks; leftover tail
+                    # groups are deliberate routing — count them apart so
+                    # the bench's honesty metric stays meaningful.
+                    if len(doc.content) > over_length:
+                        METRICS.inc("worker_host_fallback_total")
+                    else:
+                        METRICS.inc("worker_host_tail_total")
+                    outcome = execute_processing_pipeline(self.host_executor, doc)
+                    if outcome is not None:
+                        yield outcome
+            if pending is not None:
+                ta = time.perf_counter()
+                outcomes, alive = self.assemble_phase(*pending)
+                t_assemble += time.perf_counter() - ta
+                survivors.extend(alive)
+                yield from outcomes
+            if debug:
+                print(
+                    f"[phase {phase}] docs={n_in} batches={n_batches} "
+                    f"survivors={len(survivors)} {time.perf_counter()-t0:.2f}s "
+                    f"(dispatch {t_dispatch:.2f}s assemble {t_assemble:.2f}s)",
+                    flush=True,
+                )
+            current = survivors
+            if not current:
+                break
 
     _BADWORDS_PASS_STAMPS = (("c4_badwords_filter_status", "passed"),)
 
-    def _assemble(
-        self, evals: List[_StepEval], row: int, doc: TextDocument
-    ) -> ProcessingOutcome:
-        for step, ev in zip(self.device_steps, evals):
+    def _assemble_row(
+        self, evals, row: int, doc: TextDocument
+    ) -> Optional[ProcessingOutcome]:
+        """Walk one row through this phase's steps; ``None`` means it passed
+        them all (the caller decides success vs next-phase survival)."""
+        for step, ev in evals:
             if ev.badwords_default_language is not None:
                 # Fast path only for non-candidate docs whose metadata selects
                 # the compiled tables' language; everything else runs the real
@@ -919,9 +1073,7 @@ class CompiledPipeline:
                     self._rewrite_c4(doc, step, decision.extra["keep_mask"])
             if not decision.passed:
                 return ProcessingOutcome.filtered(doc, decision.reason)
-        if self.host_steps:
-            return execute_processing_pipeline(self.host_suffix_executor, doc)
-        return ProcessingOutcome.success(doc)
+        return None
 
 
 _EVALS = {
@@ -960,7 +1112,6 @@ def process_documents_device(
         pipeline = CompiledPipeline(
             config, buckets=buckets, batch_size=device_batch or 256, mesh=mesh
         )
-    buckets = pipeline.buckets
 
     if pipeline.fully_host or not pipeline.device_steps:
         if pipeline.device_steps and pipeline.fully_host:
@@ -984,21 +1135,16 @@ def process_documents_device(
                 continue
             yield item
 
-    # One batch in flight: dispatch batch k+1 before assembling batch k, so
-    # host-side assembly overlaps device compute.
-    pending: Optional[Tuple[PackedBatch, Dict[str, jax.Array]]] = None
-    for batch, fallback in iter_packed_batches(
-        doc_stream(), batch_size=pipeline.batch_size, buckets=buckets
-    ):
-        if batch is not None:
-            stats = pipeline.dispatch_batch(batch)
-            if pending is not None:
-                yield from pipeline.assemble_batch(*pending)
-            pending = (batch, stats)
-        for doc in fallback:
-            METRICS.inc("worker_host_fallback_total")
-            outcome = execute_processing_pipeline(pipeline.host_executor, doc)
-            if outcome is not None:
-                yield outcome
-    if pending is not None:
-        yield from pipeline.assemble_batch(*pending)
+    # Macro-chunks through the phased pipeline: each chunk runs phase by
+    # phase with survivors repacked between phases, and one batch in flight
+    # per phase (assembly overlaps device compute).  Larger chunks amortize
+    # the partial batches each phase flushes at its end.
+    from itertools import islice
+
+    chunk_size = max(4 * pipeline.batch_size, 4096)
+    stream = doc_stream()
+    while True:
+        chunk = list(islice(stream, chunk_size))
+        if not chunk:
+            break
+        yield from pipeline.process_chunk(chunk)

@@ -42,6 +42,7 @@ from .device import (
     LOWER,
     PUNCT,
     WS,
+    assoc_scan1,
     classify,
     isin_sorted,
     lower_table,
@@ -94,22 +95,38 @@ def hash_string(s: str) -> int:
     return h - (1 << 32) if h >= (1 << 31) else h
 
 
-def _poly_hash(cps: jax.Array, in_seg: jax.Array, seg_start: jax.Array) -> jax.Array:
-    """Segmented polynomial hash h = h*31 + cp via affine associative scan.
+def _poly_hash_many(
+    values: Tuple[jax.Array, ...], in_seg: jax.Array, seg_start: jax.Array
+) -> Tuple[jax.Array, ...]:
+    """Segmented polynomial hashes h = h*31 + v via ONE affine scan shared by
+    all ``values`` streams (they share the multiplier pattern, so fusing them
+    shares the carry-multiply work and the scan's memory passes).
 
     Positions outside segments are pass-through; ``seg_start`` restarts.
     The value at each position is the hash of its segment's prefix.
     """
     m = jnp.where(seg_start, 0, jnp.where(in_seg, 31, 1)).astype(jnp.int32)
-    a = jnp.where(in_seg, cps, 0).astype(jnp.int32)
+    accs = tuple(jnp.where(in_seg, v, 0).astype(jnp.int32) for v in values)
 
     def compose(x, y):
-        mx, ax = x
-        my, ay = y
-        return mx * my, ay + my * ax
+        mx, axs = x[0], x[1:]
+        my, ays = y[0], y[1:]
+        return (mx * my,) + tuple(ay + my * ax for ax, ay in zip(axs, ays))
 
-    _, h = jax.lax.associative_scan(compose, (m, a), axis=1)
-    return h
+    from .device import _use_shift_scan, shift_scan_tuple
+
+    if _use_shift_scan():
+        # Affine identity is (m=1, a=0, ...) — one shared scan schedule
+        # (device.shift_scan_tuple).
+        identities = (1,) + tuple(0 for _ in accs)
+        return shift_scan_tuple(compose, identities, (m,) + accs, axis=1)[1:]
+
+    out = jax.lax.associative_scan(compose, (m,) + accs, axis=1)
+    return out[1:]
+
+
+def _poly_hash(cps: jax.Array, in_seg: jax.Array, seg_start: jax.Array) -> jax.Array:
+    return _poly_hash_many((cps,), in_seg, seg_start)[0]
 
 
 def _scatter(values, idx, active, m, fill=0, op="set"):
@@ -152,7 +169,13 @@ class TextStructure(NamedTuple):
     word_idx: jax.Array  # [B, L] int32 at valid unit_end — word ordinal
 
 
-def structure(cps: jax.Array, lengths: jax.Array) -> TextStructure:
+def structure(
+    cps: jax.Array, lengths: jax.Array, with_hashes: bool = True
+) -> TextStructure:
+    """``with_hashes=False`` skips the two polynomial-hash scans (the unit
+    hash fields come back ``None``) — only GopherQuality (stop-word lhash)
+    and GopherRepetition (dup-table hash/bytes) consume them, and the hash
+    scans are a large share of this kernel's memory passes."""
     _, length = cps.shape
     mask = jnp.arange(length, dtype=jnp.int32)[None, :] < lengths[:, None]
     cls = classify(cps)
@@ -178,18 +201,33 @@ def structure(cps: jax.Array, lengths: jax.Array) -> TextStructure:
     unit_end = in_unit & (~next_in_unit | next_start)
 
     ones = jnp.where(in_unit, 1, 0).astype(jnp.int32)
-    unit_len = seg_scan_add(ones, unit_start)
     widths = jnp.where(in_unit, utf8_width(cps), 0)
-    unit_bytes = seg_scan_add(widths, unit_start)
     nonpunct = jnp.where(in_unit, (~punct).astype(jnp.int32), 0)
-    unit_valid = seg_scan_or(nonpunct, unit_start) > 0
     alpha = jnp.where(in_unit, ((cls & ALPHA) != 0).astype(jnp.int32), 0)
-    unit_alpha = seg_scan_or(alpha, unit_start) > 0
 
-    unit_hash = _poly_hash(cps, in_unit, unit_start)
-    lt = lower_table()
-    low = lt[jnp.minimum(cps, lt.shape[0] - 1)]
-    unit_lhash = _poly_hash(low, in_unit, unit_start)
+    if length <= 8192:
+        # Fuse the four per-unit aggregates into two packed add-scans: within
+        # a unit, chars <= 8192 (14 bits used: counts <= 2^13) and UTF-8
+        # bytes <= 4*8192 (field below bit 17), so len<<17|bytes and
+        # nonpunct<<16|alpha add without cross-field carries.
+        packed_a = seg_scan_add(ones * jnp.int32(1 << 17) + widths, unit_start)
+        packed_b = seg_scan_add(nonpunct * jnp.int32(1 << 16) + alpha, unit_start)
+        unit_len = packed_a >> 17
+        unit_bytes = packed_a & jnp.int32((1 << 17) - 1)
+        unit_valid = (packed_b >> 16) > 0
+        unit_alpha = (packed_b & jnp.int32((1 << 16) - 1)) > 0
+    else:
+        unit_len = seg_scan_add(ones, unit_start)
+        unit_bytes = seg_scan_add(widths, unit_start)
+        unit_valid = seg_scan_or(nonpunct, unit_start) > 0
+        unit_alpha = seg_scan_or(alpha, unit_start) > 0
+
+    if with_hashes:
+        lt = lower_table()
+        low = lt[jnp.minimum(cps, lt.shape[0] - 1)]
+        unit_hash, unit_lhash = _poly_hash_many((cps, low), in_unit, unit_start)
+    else:
+        unit_hash = unit_lhash = None
 
     valid_end = unit_end & unit_valid
     word_idx = jnp.cumsum(valid_end.astype(jnp.int32), axis=1) - 1
@@ -225,6 +263,45 @@ def _match_pattern(src: jax.Array, mask: jax.Array, pattern: str) -> jax.Array:
         mk = jnp.pad(mask[:, k:], ((0, 0), (0, k)), constant_values=False)
         hit = hit & (shifted == ord(ch)) & mk
     return hit
+
+
+def _pattern_union_starts(
+    src: jax.Array, mask: jax.Array, patterns: Tuple[str, ...]
+) -> jax.Array:
+    """[B, L] bool: some pattern in ``patterns`` starts at each position.
+
+    Two-phase: rolling-hash window candidates (one affine scan + one
+    gather/multiply/compare per pattern), then the exact shifted-compare
+    match under a batch-global ``lax.cond`` taken only when a candidate
+    exists.  Clean batches — the common case for lorem-ipsum / javascript /
+    policy text — pay only the hash pass; decisions always come from the
+    exact compare, so hash collisions cannot alter semantics.
+    """
+    vals = jnp.where(mask, src, 0)
+    first = jnp.zeros_like(mask).at[:, 0].set(True)
+    h_inc = _poly_hash(vals, jnp.ones_like(mask), first)  # inclusive prefix hash
+    h_exc = _shift_r(h_inc, 0)  # hash of chars [0, i)
+
+    def to_i32(u: int) -> np.int32:
+        u &= 0xFFFFFFFF
+        return np.int32(u - (1 << 32)) if u >= (1 << 31) else np.int32(u)
+
+    cand = jnp.zeros_like(mask)
+    for pat in patterns:
+        n = len(pat)
+        target = np.int32(hash_string(pat))
+        pw = to_i32(pow(31, n, 1 << 32))
+        # Window [i, i+n): hash = h_inc[i+n-1] - h_exc[i] * 31^n (int32 wrap).
+        h_end = jnp.pad(h_inc[:, n - 1 :], ((0, 0), (0, n - 1)))
+        cand = cand | ((h_end - h_exc * pw == target) & mask)
+
+    def verify():
+        hit = jnp.zeros_like(mask)
+        for pat in patterns:
+            hit = hit | _match_pattern(src, mask, pat)
+        return hit
+
+    return jax.lax.cond(jnp.any(cand), verify, lambda: jnp.zeros_like(mask))
 
 
 # --- Line structure ----------------------------------------------------------
@@ -689,50 +766,55 @@ def _greedy_dup_bytes_batched(jobs) -> Dict[str, jax.Array]:
     (text.rs:241-259); see module docstring for the visited-set approximation.
 
     The greedy left-to-right selection (a hit at window ``i`` blocks windows
-    ``i+1..i+n-1``) is an ``n``-state machine over the per-window dup flags:
-    state = positions still blocked.  All n-gram sizes are evaluated in one
-    log-depth associative composition of per-position state maps (padded to
-    the largest state count and stacked along the batch axis) rather than a
-    length-``m`` sequential ``lax.scan`` — the scan dominated both compile
-    and run time on TPU at ``m`` up to 16384.
+    ``i+1..i+n-1``) is a pointer-jumping chain: from search position ``j``
+    the next selected window is ``nd(j)`` (first dup flag at or after ``j``)
+    and the search resumes at ``nd(j)+n``.  Binary lifting squares the jump
+    tables log(m) times — two ``[kB, m+1]`` gathers per level, all n-gram
+    sizes stacked along the batch axis — and an absorbing terminal slot at
+    ``m`` makes the overshoot past the chain's data-dependent length
+    harmless.  (Replaced an n-state DFA composition whose compose step was a
+    10-wide gather per element — ~5x the memory traffic of this form.)
     """
     out: Dict[str, jax.Array] = {}
     direct = [(n, dup, gb) for n, dup, gb in jobs if n <= 1]
-    dfa = [(n, dup, gb) for n, dup, gb in jobs if n > 1]
+    lift = [(n, dup, gb) for n, dup, gb in jobs if n > 1]
     for n, dup, gb in direct:
         out[f"dup_{n}"] = jnp.sum(jnp.where(dup, gb, 0), axis=1).astype(jnp.int32)
-    if not dfa:
+    if not lift:
         return out
 
-    n_states = max(n for n, _, _ in dfa)
-    fns = []
-    for n, dup, _ in dfa:
-        # States 0..n-1; 0 = free.  Symbol 1 (dup) at a free position selects
-        # the window and blocks the next n-1; any symbol decrements a block.
-        # States >= n are unreachable padding (mapped to 0).
-        # (A nibble-packed two-word compose was tried and measured SLOWER
-        # than this gather form on XLA:CPU at 10 states — the per-nibble
-        # routing needs selects between the words; revisit only with TPU
-        # measurements in hand.  The <=8-state automata in ops/dfa.py do use
-        # the packed form, where it wins.)
-        t = np.zeros((2, n_states), dtype=np.int32)
-        for s in range(1, n):
-            t[0, s] = s - 1
-            t[1, s] = s - 1
-        t[1, 0] = n - 1
-        fns.append(jnp.asarray(t, dtype=jnp.int32)[dup.astype(jnp.int32)])
+    b, m = lift[0][1].shape
+    idx = jnp.arange(m, dtype=jnp.int32)[None, :]
+    jumps, sums = [], []
+    for n, dup, gb in lift:
+        # nd[i]: index of the first dup window at or after i (m if none) —
+        # a reverse running-min over idx-where-dup.
+        nd = rev(
+            assoc_scan1(jnp.minimum, _I32_MAX, rev(jnp.where(dup, idx, jnp.int32(m))))
+        )
+        sel_gb = jnp.where(
+            nd < m,
+            jnp.take_along_axis(gb, jnp.minimum(nd, m - 1), axis=1),
+            0,
+        ).astype(jnp.int32)
+        j0 = jnp.minimum(nd + jnp.int32(n), jnp.int32(m))
+        jumps.append(jnp.concatenate([j0, jnp.full((b, 1), m, jnp.int32)], axis=1))
+        sums.append(jnp.concatenate([sel_gb, jnp.zeros((b, 1), jnp.int32)], axis=1))
 
-    stacked = jnp.concatenate(fns, axis=0)  # [kB, m, n_states]
-
-    def compose(a, b_):
-        return jnp.take_along_axis(b_, a, axis=-1)
-
-    states = jax.lax.associative_scan(compose, stacked, axis=1)[..., 0]
-    b = dfa[0][1].shape[0]
-    for i, (n, dup, gb) in enumerate(dfa):
-        state = states[i * b : (i + 1) * b]
-        selected = dup & (_shift_r(state, 0) == 0)
-        out[f"dup_{n}"] = jnp.sum(jnp.where(selected, gb, 0), axis=1).astype(jnp.int32)
+    jump = jnp.concatenate(jumps, axis=0)  # [kB, m+1]
+    ssum = jnp.concatenate(sums, axis=0)
+    pos = jnp.zeros((jump.shape[0], 1), jnp.int32)
+    tot = jnp.zeros((jump.shape[0], 1), jnp.int32)
+    steps = 1
+    while steps <= m:
+        tot = tot + jnp.take_along_axis(ssum, pos, axis=1)
+        pos = jnp.take_along_axis(jump, pos, axis=1)
+        if steps * 2 <= m:
+            ssum = ssum + jnp.take_along_axis(ssum, jump, axis=1)
+            jump = jnp.take_along_axis(jump, jump, axis=1)
+        steps *= 2
+    for i, (n, dup, gb) in enumerate(lift):
+        out[f"dup_{n}"] = tot[i * b : (i + 1) * b, 0]
     return out
 
 
@@ -856,7 +938,10 @@ def c4_stage(
     low = _lowered(cps, mask)
 
     # Doc-level early rejects (c4_filters.rs:166-187).
-    has_lorem = jnp.any(_match_pattern(low, mask, "lorem ipsum"), axis=1)
+    if params.filter_lorem_ipsum:
+        has_lorem = jnp.any(_pattern_union_starts(low, mask, ("lorem ipsum",)), axis=1)
+    else:
+        has_lorem = jnp.zeros(cps.shape[0], dtype=bool)
     has_curly = jnp.any(((cps == ord("{")) | (cps == ord("}"))) & mask, axis=1)
 
     li = line_info(cps, mask)
@@ -870,10 +955,17 @@ def c4_stage(
     in_line_trim = li.content & after_first & before_last
 
     if params.remove_citations:
-        deleted = citation_spans(
-            jnp.where(li.content, cps, 0),
-            ((cls & DIGIT) != 0) & li.content,
-            ws & li.content,
+        # Citation machinery only runs on batches that contain a '[' at all
+        # (rare in clean text — the same skip the oracle's regex scan gets
+        # from its first-byte check).
+        deleted = jax.lax.cond(
+            jnp.any((cps == ord("[")) & mask),
+            lambda: citation_spans(
+                jnp.where(li.content, cps, 0),
+                ((cls & DIGIT) != 0) & li.content,
+                ws & li.content,
+            ),
+            lambda: jnp.zeros_like(mask),
         )
     else:
         deleted = jnp.zeros_like(mask)
@@ -883,7 +975,7 @@ def c4_stage(
 
     # --- per-line checks on the compacted batch ---
     m1 = jnp.arange(length, dtype=jnp.int32)[None, :] < c1_len[:, None]
-    st1 = structure(c1_cps, c1_len)
+    st1 = structure(c1_cps, c1_len, with_hashes=False)
     li1 = line_info(c1_cps, m1)
     low1 = _lowered(c1_cps, m1)
 
@@ -908,18 +1000,23 @@ def c4_stage(
     )
     ends_ellipsis = line_end_dots >= 3
 
-    def line_has_pattern(pat: str) -> jax.Array:
-        hit = _match_pattern(low1, m1, pat)
-        return (
-            _scatter(hit.astype(jnp.int32), li1.line_id, hit, max_lines, op="add") > 0
-        )
-
+    # Only the UNION of javascript/policy line flags affects line_keep (no
+    # per-cause stats are reported), so all patterns share one candidate
+    # pass (_pattern_union_starts).
     zeros_ml = jnp.zeros_like(ends_terminal)
-    has_js = line_has_pattern("javascript") if params.filter_javascript else zeros_ml
-    has_policy = zeros_ml
+    line_patterns: Tuple[str, ...] = ()
+    if params.filter_javascript:
+        line_patterns += ("javascript",)
     if params.filter_policy:
-        for p in _POLICY:
-            has_policy = has_policy | line_has_pattern(p)
+        line_patterns += _POLICY
+    if line_patterns:
+        starts = _pattern_union_starts(low1, m1, line_patterns)
+        bad_pattern_line = (
+            _scatter(starts.astype(jnp.int32), li1.line_id, starts, max_lines, op="add")
+            > 0
+        )
+    else:
+        bad_pattern_line = zeros_ml
 
     # Line count comes from the ORIGINAL batch: a final line whose content
     # trimmed away entirely has no chars and no trailing \n in the compacted
@@ -943,10 +1040,7 @@ def c4_stage(
     else:
         drop_few_words = jnp.zeros_like(remaining)
     remaining = remaining & ~drop_few_words
-    drop_js = remaining & has_js
-    remaining = remaining & ~drop_js
-    drop_policy = remaining & has_policy
-    line_keep = remaining & ~drop_policy
+    line_keep = remaining & ~bad_pattern_line
 
     # --- compact kept lines into the rewritten batch ---
     later = rev(jnp.cumsum(rev(line_keep.astype(jnp.int32)), axis=1), axis=1)

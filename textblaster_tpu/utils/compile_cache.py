@@ -23,9 +23,15 @@ DEFAULT_CACHE_DIR = os.path.join(
 
 def enable_compilation_cache(cache_dir: str | None = None) -> str:
     """Point JAX's persistent compilation cache at ``cache_dir`` (created if
-    missing).  Returns the directory used."""
+    missing).  Returns the directory used.
+
+    ``TEXTBLAST_NO_COMPILE_CACHE=1`` turns this into a no-op (measurement
+    escape hatch: cache-loaded XLA:CPU executables can differ in performance
+    from the in-memory JIT result of a fresh compile)."""
     import jax
 
+    if os.environ.get("TEXTBLAST_NO_COMPILE_CACHE") == "1":
+        return ""
     cache_dir = cache_dir or DEFAULT_CACHE_DIR
     os.makedirs(cache_dir, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache_dir)

@@ -61,6 +61,11 @@ _SPECS: Dict[str, Tuple[str, str]] = {
         "Documents rerouted to the host oracle (kernel table overflow or "
         "over-length outliers)",
     ),
+    "worker_host_tail_total": (
+        "counter",
+        "Documents deliberately routed to the host oracle as end-of-stream "
+        "tail groups too small to justify a padded device batch",
+    ),
 }
 
 
