@@ -211,6 +211,7 @@ class CompiledPipeline:
         buckets=DEFAULT_BUCKETS,
         batch_size: int = 256,
         mesh=None,
+        phase_split: bool = True,
     ) -> None:
         self.config = config
         self.buckets = tuple(sorted(buckets))
@@ -244,10 +245,15 @@ class CompiledPipeline:
         # Multi-phase short-circuiting only for single-controller runs: a
         # multi-host SPMD job must dispatch identical programs in lockstep,
         # and per-host survivor counts differ (parallel/multihost.py).
-        # TEXTBLAST_PHASES=off pins the single fused program.
+        # TEXTBLAST_PHASES=off (or phase_split=False) pins the single fused
+        # program.
         import os as _os
 
-        if mesh is None and _os.environ.get("TEXTBLAST_PHASES") != "off":
+        if (
+            phase_split
+            and mesh is None
+            and _os.environ.get("TEXTBLAST_PHASES") != "off"
+        ):
             self.phases = _split_phases(self.device_steps)
         else:
             self.phases = [list(range(len(self.device_steps)))]
