@@ -24,9 +24,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # CPU-only, hang-proof: the baked remote-TPU plugin otherwise initializes on
 # first backend use and can block the whole suite while the remote chip is
 # claimed elsewhere (see utils/backend_guard.py).
-from textblaster_tpu.utils.backend_guard import force_cpu_backend  # noqa: E402
+from textblaster_tpu.utils.backend_guard import (  # noqa: E402
+    enable_cpu_x64,
+    force_cpu_backend,
+)
 
 force_cpu_backend()
+# Production CPU configuration (bench fallback, CLI --backend cpu): x64 on,
+# so sort2 takes its packed-int64 path — the suite validates exactly what
+# runs.  test_pallas_sort pins the x64-off two-operand fallback's agreement
+# separately (the config real-TPU lax fallbacks use).
+enable_cpu_x64()
 
 # Keep every document on the DEVICE path in tests: the runtime's host-oracle
 # tail routing (ops/pipeline.py process_chunk) would otherwise hand small

@@ -259,6 +259,10 @@ def test_cli_roundtrip_matches_host_10k(corpus, host_outcomes, tmp_path):
             "--output-file", str(out),
             "--excluded-file", str(excl),
             "--device-batch", "512",
+            # Same bucket set as the in-process device runs above: the CLI
+            # then reuses their cached programs instead of cold-compiling the
+            # built-in long-doc set (minutes at the 32k/65k buckets).
+            "--buckets", ",".join(str(b) for b in BUCKETS),
             "--quiet",
         ]
     )

@@ -1,0 +1,128 @@
+"""Randomized multi-script differential fuzz: host oracle vs device path.
+
+The structured parity suites (`test_device_parity`, `test_reference_parity`,
+`test_e2e_shard`) pin known behaviors; this suite hunts *unknown* divergence
+by generating seeded pseudo-random documents that mix scripts (Latin with
+combining marks, Greek, Cyrillic, Arabic, Hebrew, CJK, Hangul, Thai, emoji
+with ZWJ), exotic whitespace (NBSP, ideographic space, zero-width space),
+citation/bracket/policy trigger substrings, repeated fragments, and edge
+lengths — then asserts the compiled device pipeline reproduces the host
+filters' outcome, reason string, rewritten content, and metadata exactly.
+
+Deterministic (fixed seed): a failure is a real reproducible parity bug, not
+flake.  The analogue in the reference's strategy is its per-filter unit
+suites (SURVEY.md §4); differential fuzz is the batched-kernel equivalent.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tests.test_device_parity import (
+    PIPELINE_YAML,
+    assert_outcomes_equal,
+    run_both,
+)
+
+SEED = 0xB1A57
+
+DANISH_WORDS = (
+    "det er en god dag og vi skal ud at gå tur i skoven solen skinner over "
+    "byen der mange mennesker på gaden efter turen vil gerne drikke kop "
+    "kaffe spise lidt brød hjemme bliver dejlig eftermiddag fordi vejret så"
+).split()
+
+ENGLISH_WORDS = (
+    "the quick brown fox jumps over a lazy dog and all of them have many "
+    "things to do with their time in this busy little town every day"
+).split()
+
+# Script/edge fragments.  Each is deliberately short; documents are built by
+# sampling and joining many of them.
+FRAGMENTS = [
+    "Ελληνικά κείμενα εδώ.",                    # Greek
+    "Русский текст здесь.",                     # Cyrillic
+    "نص عربي هنا.",                             # Arabic (RTL)
+    "טקסט בעברית כאן.",                         # Hebrew (RTL)
+    "中文文本在这里。",                           # Han
+    "日本語のテキスト。",                         # Han + Hiragana
+    "한국어 텍스트입니다.",                       # Hangul
+    "ข้อความภาษาไทย",                           # Thai (no spaces)
+    "café naïve résumé Zürich",                 # Latin-1 accents
+    "ééé combining acute",    # combining marks (NFD)
+    "👩‍👩‍👧‍👦 family emoji and 🇩🇰 flag",            # ZWJ sequences
+    "word with nbsp here",            # NBSP
+    "ideographic　space",                   # U+3000
+    "zero​width​space",               # ZWSP (Format char)
+    "[1] cited text [2, 3] more [45]",          # citation patterns
+    "{ curly } text",                           # curly braces
+    "lorem ipsum dolor",                        # lorem trigger
+    "enable javascript to continue",            # javascript trigger
+    "read our privacy policy",                  # policy trigger
+    "this site uses cookies",                   # policy trigger
+    "- bullet item",                            # bullet line
+    "trailing ellipsis…",                       # ellipsis (U+2026)
+    "trailing dots...",                         # ellipsis (ASCII)
+    "\"quoted line.\"",                         # terminal quote
+    "don't can’t won’t",                        # apostrophes
+    "1,000.5 and 42% of $3.14",                 # numbers/symbols
+    "### ## #",                                 # symbol words
+    "a",                                        # single char
+    "supercalifragilisticexpialidocious" * 3,   # long word
+    "́",                                   # lone combining mark
+    "‍",                                   # lone ZWJ
+]
+
+SEPARATORS = [" ", " ", " ", "\n", "\n", "\n\n", "\t", "  "]
+
+
+def _sentence(rng: np.random.Generator) -> str:
+    words = DANISH_WORDS if rng.random() < 0.6 else ENGLISH_WORDS
+    n = int(rng.integers(3, 14))
+    ws = [words[int(rng.integers(0, len(words)))] for _ in range(n)]
+    end = "." if rng.random() < 0.8 else ("!" if rng.random() < 0.5 else "?")
+    return " ".join(ws).capitalize() + end
+
+
+def _make_doc(rng: np.random.Generator) -> str:
+    parts = []
+    n_parts = int(rng.integers(1, 14))
+    for _ in range(n_parts):
+        r = rng.random()
+        if r < 0.55:
+            parts.append(_sentence(rng))
+        elif r < 0.85:
+            parts.append(FRAGMENTS[int(rng.integers(0, len(FRAGMENTS)))])
+        else:  # repetition block
+            unit = (
+                _sentence(rng)
+                if rng.random() < 0.5
+                else FRAGMENTS[int(rng.integers(0, len(FRAGMENTS)))]
+            )
+            reps = int(rng.integers(2, 7))
+            parts.extend([unit] * reps)
+    out = []
+    for i, p in enumerate(parts):
+        if i:
+            out.append(SEPARATORS[int(rng.integers(0, len(SEPARATORS)))])
+        out.append(p)
+    content = "".join(out)
+    # Keep every doc inside the 2048 bucket the structured suites already
+    # compile (cap conservatively below the packer margin).
+    return content[:2000]
+
+
+def test_fuzz_multiscript_parity():
+    rng = np.random.default_rng(SEED)
+    texts = [_make_doc(rng) for _ in range(160)]
+    # Guaranteed edge docs on top of the random mix.
+    texts += ["", " ", "\n\n\n", "‍", "́", "…", "interview"]
+    host_by_id, dev_by_id = run_both(PIPELINE_YAML, texts)
+    assert_outcomes_equal(host_by_id, dev_by_id)
+
+
+def test_fuzz_second_seed_parity():
+    rng = np.random.default_rng(SEED + 1)
+    texts = [_make_doc(rng) for _ in range(96)]
+    host_by_id, dev_by_id = run_both(PIPELINE_YAML, texts)
+    assert_outcomes_equal(host_by_id, dev_by_id)

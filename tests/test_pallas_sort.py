@@ -75,3 +75,27 @@ def test_sort3_dispatch_cpu_fallback():
     want = _oracle(k1, k2, k3)
     for g, w in zip(got, want):
         np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+def test_sort2_packed_vs_two_operand_fallback():
+    """The packed-int64 sort2 path (x64 on — the production CPU config this
+    suite runs under) must agree exactly with the x64-off two-operand stable
+    lax.sort (the config real-TPU lax fallbacks use)."""
+    from textblaster_tpu.ops.pallas_sort import sort2
+
+    rng = np.random.default_rng(7)
+    # Row length past the Pallas support bound so sort2 takes the lax path;
+    # duplicate-heavy keys exercise within-run payload ordering, and negative
+    # keys the packed form's sign handling.
+    b, m = 8, 1 << 15
+    k1 = rng.integers(-50, 50, size=(b, m)).astype(np.int32)
+    k2 = np.tile(np.arange(m, dtype=np.int32), (b, 1))
+    assert jax.config.jax_enable_x64, "suite runs the production CPU config"
+    got_packed = [np.asarray(x) for x in sort2(jnp.asarray(k1), jnp.asarray(k2))]
+    try:
+        jax.config.update("jax_enable_x64", False)
+        got_two_op = [np.asarray(x) for x in sort2(jnp.asarray(k1), jnp.asarray(k2))]
+    finally:
+        jax.config.update("jax_enable_x64", True)
+    for g, w in zip(got_packed, got_two_op):
+        np.testing.assert_array_equal(g, w)

@@ -195,6 +195,7 @@ def run_checkpointed(
     backend: str = "tpu",
     read_batch_size: int = 1024,
     device_batch: Optional[int] = None,
+    buckets=None,
     mesh=None,
     progress: Optional[Callable[[AggregationResult], None]] = None,
     stop_after_chunks: Optional[int] = None,
@@ -267,8 +268,9 @@ def run_checkpointed(
 
         if mesh is None and len(jax.devices()) > 1:
             mesh = data_mesh()  # same sharding as the non-checkpointed runner
+        pkw = {} if buckets is None else {"buckets": buckets}
         pipeline = CompiledPipeline(
-            config, batch_size=device_batch or 256, mesh=mesh
+            config, batch_size=device_batch or 256, mesh=mesh, **pkw
         )
 
         def process_chunk(items) -> Iterator[ProcessingOutcome]:

@@ -254,11 +254,15 @@ def sort2(
     """Row sort by key ``k1`` carrying ``k2``, deterministic within equal
     keys: ascending ``k2`` order.
 
-    Off-TPU this is the 1-key *stable* ``lax.sort`` (callers pass ``k2``
-    either already ascending per row — an iota — or as a payload whose
-    within-run order is irrelevant); on TPU it is the VMEM bitonic network
-    sorting the full ``(k1, k2)`` pair, which is equivalent up to within-run
-    payload order (and exactly equal for iota payloads)."""
+    On TPU this is the VMEM bitonic network sorting the full ``(k1, k2)``
+    pair.  Elsewhere, when int64 is live (``jax_enable_x64`` — the CPU
+    backend enables it for exactly this), the pair is packed into ONE
+    ``(k1 << 32) | k2`` int64 operand and sorted with the single-operand
+    ``lax.sort``, which XLA:CPU runs ~4.4x faster than the two-operand
+    comparator form (measured [9216, 512]: 188ms vs 837ms); unpacked order
+    is (k1, then k2) — identical to the stable form for non-negative
+    payloads, which every caller passes (iotas or byte lengths).  With x64
+    off, the 1-key *stable* two-operand ``lax.sort`` is used."""
     b, m = k1.shape
     n_dev = _data_axis_size(mesh)
     if n_dev is not None and n_dev > 1:
@@ -266,6 +270,13 @@ def sort2(
             return _sharded_sort(_dispatch, mesh, (k1, k2))
     elif n_dev == 1 and _pallas_ok(b, m):
         return pallas_sort2(k1, k2, interpret=_interpret_forced())
+    if jax.config.jax_enable_x64:
+        z = (k1.astype(jnp.int64) << 32) | k2.astype(jnp.int64)
+        s = jax.lax.sort(z, dimension=1)
+        return (
+            (s >> 32).astype(jnp.int32),
+            (s & jnp.int64(0xFFFFFFFF)).astype(jnp.int32),
+        )
     return jax.lax.sort(
         (k1.astype(jnp.int32), k2.astype(jnp.int32)),
         dimension=1,

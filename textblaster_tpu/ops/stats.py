@@ -17,11 +17,14 @@ undefined in XLA.
 Known device/oracle divergences (each measured by the parity suite,
 tests/test_device_parity.py):
 * duplicate detection compares 32-bit content hashes, not strings —
-  cross-content collisions are ~2^-32 per pair;
-* ``find_all_duplicate``'s greedy skip treats "earlier occurrence" as any
-  earlier window, where the oracle only consults *visited* windows
-  (text.rs:241-259); these differ only when a window's sole earlier twin was
-  itself inside a skipped span.
+  cross-content collisions are ~2^-32 per pair.
+
+(``find_all_duplicate``'s visited-set dynamics — the oracle's ``seen`` only
+holds windows the greedy scan actually *visited*, so a window whose only
+earlier twins were skipped over is NOT a duplicate — is reproduced exactly
+by ``_find_all_dup_bytes_batched``'s lockstep walk; an earlier static
+"any earlier twin" approximation diverged on dense repetitions and was
+caught by tests/test_fuzz_parity.py.)
 """
 
 from __future__ import annotations
@@ -710,42 +713,45 @@ def gopher_rep_stats(
             jobs.append((gh, idx, win_valid))
             tags.append(("dup", n))
 
-    dup_min_flags = None
+    dup_min_flags = dup_min_rid = None
     for (kind, n), srt in zip(tags, _sort_runs_many(jobs, mesh=mesh) if jobs else ()):
         if kind == "top":
             out[f"top_{n}"] = _top_duplicate_sorted(srt)
         else:
-            dup_min_flags = _dup_flags_sorted(srt, grams[n][2], idx)
+            dup_min_flags, dup_min_rid = _dup_run_info_sorted(srt, grams[n][2], idx)
 
     if dup_sizes:
         rest = dup_sizes[1:]
 
-        def _dup_work(dmf):
-            greedy = [(min_dup, dmf, grams[min_dup][1])]
+        def _dup_work(operand):
+            _, min_rid = operand
+            walk = [(min_dup, min_rid, grams[min_dup][2], grams[min_dup][1])]
             if rest:
                 rjobs = [(grams[n][0], idx, grams[n][2]) for n in rest]
                 for n, srt in zip(rest, _sort_runs_many(rjobs, mesh=mesh)):
-                    greedy.append(
-                        (n, _dup_flags_sorted(srt, grams[n][2], idx), grams[n][1])
-                    )
-            res = _greedy_dup_bytes_batched(greedy)
+                    _, rid_n = _dup_run_info_sorted(srt, grams[n][2], idx)
+                    walk.append((n, rid_n, grams[n][2], grams[n][1]))
+            res = _find_all_dup_bytes_batched(walk)
             return tuple(res[f"dup_{n}"] for n in dup_sizes)
 
-        def _dup_zero(dmf):
+        def _dup_zero(operand):
             zero = jnp.zeros_like(n_words)
             return tuple(zero for _ in dup_sizes)
 
         dup_outs = jax.lax.cond(
-            jnp.any(dup_min_flags), _dup_work, _dup_zero, dup_min_flags
+            jnp.any(dup_min_flags), _dup_work, _dup_zero, (dup_min_flags, dup_min_rid)
         )
         for n, v in zip(dup_sizes, dup_outs):
             out[f"dup_{n}"] = v
     return out
 
 
-def _dup_flags_sorted(sorted_triple, win_valid, idx) -> jax.Array:
-    """Per-window "an earlier identical window exists" flags from a
-    ``(hash, idx)``-sorted table (find_all_duplicate's dup test)."""
+def _dup_run_info_sorted(sorted_triple, win_valid, idx) -> Tuple[jax.Array, jax.Array]:
+    """``(flags, run_first)`` from a ``(hash, idx)``-sorted window table:
+    ``flags`` — "an earlier identical window exists" (a superset of
+    find_all_duplicate's dynamic dup test, used as the rarity gate);
+    ``run_first`` — each window's run id (the minimum window index sharing
+    its hash), the canonical slot for the walk's visited table."""
     is_real, s_hash, sidx = sorted_triple
     b, m = s_hash.shape
     run_start = jnp.concatenate(
@@ -758,63 +764,57 @@ def _dup_flags_sorted(sorted_triple, win_valid, idx) -> jax.Array:
     # Sorted by (hash, idx): the run's first slot holds the minimum index.
     first_in_run = seg_scan_max(jnp.where(run_start, sidx, -(2**30)), run_start)
     first_occ = _scatter(first_in_run, sidx, is_real, m)
-    return win_valid & (first_occ < idx)
+    return win_valid & (first_occ < idx), first_occ
 
 
-def _greedy_dup_bytes_batched(jobs) -> Dict[str, jax.Array]:
-    """find_all_duplicate: non-overlapping greedy scan, advancing n on a hit
-    (text.rs:241-259); see module docstring for the visited-set approximation.
+def _find_all_dup_bytes_batched(jobs) -> Dict[str, jax.Array]:
+    """find_all_duplicate, EXACT: the oracle's greedy scan with its
+    visited-set dynamics (text.rs:241-259) — ``seen`` holds only windows the
+    scan actually visited, a hit counts the window's bytes and jumps ``n``
+    (the jumped-over windows are never inserted), a miss inserts and steps 1.
 
-    The greedy left-to-right selection (a hit at window ``i`` blocks windows
-    ``i+1..i+n-1``) is a pointer-jumping chain: from search position ``j``
-    the next selected window is ``nd(j)`` (first dup flag at or after ``j``)
-    and the search resumes at ``nd(j)+n``.  Binary lifting squares the jump
-    tables log(m) times — two ``[kB, m+1]`` gathers per level, all n-gram
-    sizes stacked along the batch axis — and an absorbing terminal slot at
-    ``m`` makes the overshoot past the chain's data-dependent length
-    harmless.  (Replaced an n-state DFA composition whose compose step was a
-    10-wide gather per element — ~5x the memory traffic of this form.)
+    Every job ``(n, run_first, win_valid, gb)`` stacks along the batch axis
+    and one ``lax.scan`` over the ``m`` window positions walks all rows in
+    lockstep: the carry is a per-row visited table indexed by ``run_first``
+    (each window's canonical run id — equal hash == equal gram under the
+    module's no-collision assumption), a skip counter, and the byte
+    accumulator.  Position dynamics can't be pointer-jumped ahead of time —
+    whether a window is a duplicate depends on which of its twins were
+    themselves skipped — which is why this is a sequential scan and not the
+    earlier (approximate) binary-lifting chain.  It only runs under the
+    min-dup rarity gate, so clean batches never pay for it.
     """
     out: Dict[str, jax.Array] = {}
-    direct = [(n, dup, gb) for n, dup, gb in jobs if n <= 1]
-    lift = [(n, dup, gb) for n, dup, gb in jobs if n > 1]
-    for n, dup, gb in direct:
-        out[f"dup_{n}"] = jnp.sum(jnp.where(dup, gb, 0), axis=1).astype(jnp.int32)
-    if not lift:
+    if not jobs:
         return out
+    b, m = jobs[0][1].shape
+    n_vec = jnp.concatenate(
+        [jnp.full((b,), n, jnp.int32) for n, _, _, _ in jobs]
+    )  # [kB]
+    rid = jnp.concatenate([j[1] for j in jobs], axis=0)  # [kB, m]
+    val = jnp.concatenate([j[2] for j in jobs], axis=0)
+    gbs = jnp.concatenate([j[3] for j in jobs], axis=0)
+    rows = jnp.arange(rid.shape[0], dtype=jnp.int32)
 
-    b, m = lift[0][1].shape
-    idx = jnp.arange(m, dtype=jnp.int32)[None, :]
-    jumps, sums = [], []
-    for n, dup, gb in lift:
-        # nd[i]: index of the first dup window at or after i (m if none) —
-        # a reverse running-min over idx-where-dup.
-        nd = rev(
-            assoc_scan1(jnp.minimum, _I32_MAX, rev(jnp.where(dup, idx, jnp.int32(m))))
-        )
-        sel_gb = jnp.where(
-            nd < m,
-            jnp.take_along_axis(gb, jnp.minimum(nd, m - 1), axis=1),
-            0,
-        ).astype(jnp.int32)
-        j0 = jnp.minimum(nd + jnp.int32(n), jnp.int32(m))
-        jumps.append(jnp.concatenate([j0, jnp.full((b, 1), m, jnp.int32)], axis=1))
-        sums.append(jnp.concatenate([sel_gb, jnp.zeros((b, 1), jnp.int32)], axis=1))
+    def step(carry, xs):
+        visited, skip, acc = carry
+        rid_c, gb_c, val_c = xs  # [kB] each
+        can = (skip == 0) & val_c
+        seen = visited[rows, rid_c] > 0
+        hit = can & seen
+        acc = acc + jnp.where(hit, gb_c, 0)
+        visited = visited.at[rows, rid_c].max((can & ~seen).astype(jnp.int32))
+        skip = jnp.where(hit, n_vec - 1, jnp.maximum(skip - 1, 0))
+        return (visited, skip, acc), None
 
-    jump = jnp.concatenate(jumps, axis=0)  # [kB, m+1]
-    ssum = jnp.concatenate(sums, axis=0)
-    pos = jnp.zeros((jump.shape[0], 1), jnp.int32)
-    tot = jnp.zeros((jump.shape[0], 1), jnp.int32)
-    steps = 1
-    while steps <= m:
-        tot = tot + jnp.take_along_axis(ssum, pos, axis=1)
-        pos = jnp.take_along_axis(jump, pos, axis=1)
-        if steps * 2 <= m:
-            ssum = ssum + jnp.take_along_axis(ssum, jump, axis=1)
-            jump = jnp.take_along_axis(jump, jump, axis=1)
-        steps *= 2
-    for i, (n, dup, gb) in enumerate(lift):
-        out[f"dup_{n}"] = tot[i * b : (i + 1) * b, 0]
+    init = (
+        jnp.zeros(rid.shape, jnp.int32),
+        jnp.zeros(rid.shape[0], jnp.int32),
+        jnp.zeros(rid.shape[0], jnp.int32),
+    )
+    (_, _, acc), _ = jax.lax.scan(step, init, (rid.T, gbs.T, val.T))
+    for i, (n, _, _, _) in enumerate(jobs):
+        out[f"dup_{n}"] = acc[i * b : (i + 1) * b]
     return out
 
 

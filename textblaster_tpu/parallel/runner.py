@@ -72,6 +72,7 @@ def run_pipeline(
     backend: str = "tpu",
     read_batch_size: int = 1024,
     device_batch: Optional[int] = None,
+    buckets=None,
     quiet: bool = False,
 ) -> AggregationResult:
     progress = _Progress(enabled=not quiet)
@@ -94,12 +95,14 @@ def run_pipeline(
         from .mesh import data_mesh
 
         mesh = data_mesh() if len(jax.devices()) > 1 else None
+        kwargs = {} if buckets is None else {"buckets": buckets}
         outcomes = process_documents_device(
             config,
             docs,
             device_batch=device_batch,
             on_read_error=on_read_error,
             mesh=mesh,
+            **kwargs,
         )
     else:
         executor = build_pipeline_from_config(config)

@@ -19,7 +19,22 @@ from __future__ import annotations
 
 import os
 
-__all__ = ["force_cpu_backend"]
+__all__ = ["force_cpu_backend", "enable_cpu_x64"]
+
+
+def enable_cpu_x64() -> None:
+    """Enable 64-bit types for a CPU-by-contract process.
+
+    The duplicate-table sorts then take ``sort2``'s packed path — one
+    ``(key << 32) | payload`` int64 operand through the single-operand
+    ``lax.sort``, which XLA:CPU runs ~4.4x faster than the two-operand
+    comparator form (pallas_sort.py).  CPU-only by design: TPU processes
+    keep the default x64-off config (Mosaic kernels and the f32 compute
+    path are built for it), and the virtual-mesh dryrun mirrors the TPU
+    configuration, so neither calls this."""
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
 
 
 def force_cpu_backend() -> None:
