@@ -126,3 +126,42 @@ def test_fuzz_second_seed_parity():
     texts = [_make_doc(rng) for _ in range(96)]
     host_by_id, dev_by_id = run_both(PIPELINE_YAML, texts)
     assert_outcomes_equal(host_by_id, dev_by_id)
+
+
+GOPHER_REP_YAML = """
+pipeline:
+  - type: GopherRepetitionFilter
+    dup_line_frac: 0.3
+    dup_para_frac: 0.3
+    dup_line_char_frac: 0.2
+    dup_para_char_frac: 0.2
+    top_n_grams: [[2, 0.2], [3, 0.18], [4, 0.16]]
+    dup_n_grams: [[5, 0.15], [6, 0.14], [7, 0.13], [8, 0.12], [9, 0.11], [10, 0.10]]
+"""
+
+
+def test_fuzz_dense_repetition_walk_parity():
+    """Stress the exact find_all_duplicate walk: tiny vocabularies make many
+    colliding windows, and repetition periods 2..12 interleave with the skip
+    lengths n=5..10 — precisely the regime where the pre-fix static
+    approximation diverged (a window's only earlier twins inside skipped
+    spans)."""
+    rng = np.random.default_rng(SEED + 2)
+    texts = []
+    for _ in range(120):
+        vocab = [
+            DANISH_WORDS[int(rng.integers(0, len(DANISH_WORDS)))]
+            for _ in range(int(rng.integers(2, 9)))
+        ]
+        period = int(rng.integers(2, 13))
+        unit = " ".join(
+            vocab[int(rng.integers(0, len(vocab)))] for _ in range(period)
+        )
+        reps = int(rng.integers(3, 30))
+        sep = [" ", "\n", ". "][int(rng.integers(0, 3))]
+        text = sep.join([unit] * reps)
+        if rng.random() < 0.4:  # prefix/suffix of fresh words breaks pure cycles
+            text = _sentence(rng) + " " + text + " " + _sentence(rng)
+        texts.append(text[:2000])
+    host_by_id, dev_by_id = run_both(GOPHER_REP_YAML, texts)
+    assert_outcomes_equal(host_by_id, dev_by_id)
