@@ -1,0 +1,120 @@
+"""Scatter vs sort table-construction parity.
+
+The device kernels build their per-line/per-segment/per-word tables two ways
+(:func:`textblaster_tpu.ops.device.use_sort_tables`): XLA scatters (the CPU
+default) and a sorted compaction + gathers (the TPU default — XLA:TPU
+serializes scatters into per-element loops; see TPU_EVIDENCE_r03).  The TPU
+path cannot run on TPU in CI, but its *semantics* are backend-independent:
+this suite pins both implementations to identical outputs on the nasty-case
+corpus (blank lines, trailing newlines, all-whitespace lines, citations,
+empty docs, dense repetition), so a silicon window only has to validate
+performance, not correctness.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from textblaster_tpu.data_model import TextDocument
+from textblaster_tpu.ops import compact as C
+from textblaster_tpu.ops import langid_tpu as LT
+from textblaster_tpu.ops import stats as S
+from textblaster_tpu.ops.packing import pack_documents
+
+from test_device_parity import CORPUS
+
+EXTRA = [
+    "a\n\n\nb\nc\n",
+    "line one.\nline two.\n\n\nline one.\n",
+    "   \nword here.\n   trailing   \n.",
+    "x [1] y [2, 3] z [4]\nplain line here.",
+    "[broken [5] citation] more",
+    "a.\n\nb!\n\nc?",
+    "\n\nonly blanks\n\n",
+    "ends with newline\n",
+    "solo",
+    "." * 40,
+    ("tok " * 120) + "\n" + ("tok " * 120),
+    "æøå πολύ 北京 😀 mixed\nscripts here.",
+]
+
+ML, MW = 128, 256
+
+C4P = S.C4Params(
+    split_paragraph=True,
+    remove_citations=True,
+    filter_no_terminal_punct=True,
+    min_num_sentences=3,
+    min_words_per_line=2,
+    max_word_length=20,
+    filter_lorem_ipsum=True,
+    filter_javascript=True,
+    filter_curly_bracket=True,
+    filter_policy=True,
+)
+
+
+def _batch():
+    docs = [
+        TextDocument(id=str(i), content=c, source="s")
+        for i, c in enumerate(CORPUS + EXTRA)
+        if len(c) <= 500
+    ]
+    docs += [
+        TextDocument(id=f"p{i}", content="pad doc.", source="s")
+        for i in range((-len(docs)) % 8)
+    ]
+    return pack_documents(docs, len(docs), 512)
+
+
+def _k_rep(cps, lengths):
+    st = S.structure(cps, lengths)
+    return dict(S.gopher_rep_stats(st, (2, 3, 4), (5, 6, 10), ML, MW))
+
+
+def _k_fw(cps, lengths):
+    st = S.structure(cps, lengths)
+    out = dict(S.fineweb_stats(st, ('"', "'", ".", "!", "?", "”"), ML, 30))
+    out.update(
+        S.gopher_quality_stats(
+            st, tuple(S.hash_string(w) for w in ("og", "er", "det", "the"))
+        )
+    )
+    return out
+
+
+def _k_c4(cps, lengths):
+    c4s, c4c, c4l = S.c4_stage(cps, lengths, C4P, ML)
+    out = dict(c4s)
+    out["cps"], out["len"] = c4c, c4l
+    return out
+
+
+def _k_misc(cps, lengths):
+    import jax.numpy as jnp
+
+    keep = (cps % 3 != 0) & (jnp.arange(cps.shape[1])[None, :] < lengths[:, None])
+    cc, clen = C.compact(cps, keep)
+    sc, ng = LT.langid_scores(cps, lengths)
+    return {"c_cps": cc, "c_len": clen, "scores": sc, "n": ng}
+
+
+def _run(kernel, impl, cps, lengths, monkeypatch):
+    monkeypatch.setenv("TEXTBLAST_TABLE_IMPL", impl)
+    # Fresh jit wrapper: the impl choice is read at trace time.
+    return jax.device_get(jax.jit(kernel)(cps, lengths))
+
+
+@pytest.mark.parametrize("kernel", [_k_rep, _k_fw, _k_c4, _k_misc])
+def test_sort_tables_match_scatter(kernel, monkeypatch):
+    batch = _batch()
+    ref = _run(kernel, "scatter", batch.cps, batch.lengths, monkeypatch)
+    got = _run(kernel, "sort", batch.cps, batch.lengths, monkeypatch)
+    assert set(ref) == set(got)
+    for k in ref:
+        np.testing.assert_array_equal(
+            np.asarray(ref[k]), np.asarray(got[k]), err_msg=k
+        )

@@ -31,6 +31,8 @@ __all__ = [
     "seg_scan_add",
     "seg_scan_or",
     "seg_scan_max",
+    "latch_scan",
+    "use_sort_tables",
     "rev",
     "ALNUM",
     "ALPHA",
@@ -158,6 +160,13 @@ def _seg_max_op(a, b):
     return jnp.where(br, bv, jnp.maximum(av, bv)), ar | br
 
 
+def _latch_op(a, b):
+    # "Rightmost set value" monoid: b wins where it is set.
+    av, ar = a
+    bv, br = b
+    return jnp.where(br, bv, av), ar | br
+
+
 def _use_shift_scan() -> bool:
     import os
 
@@ -242,6 +251,31 @@ def seg_scan_or(values: jax.Array, reset: jax.Array, axis: int = 1) -> jax.Array
 
 def seg_scan_max(values: jax.Array, reset: jax.Array, axis: int = 1) -> jax.Array:
     return _seg_scan(_seg_max_op, np.iinfo(np.int32).min, values, reset, axis)
+
+
+def latch_scan(values: jax.Array, set_mask: jax.Array, axis: int = 1) -> jax.Array:
+    """Inclusive "hold" scan: at each position, the value of the most recent
+    position where ``set_mask`` is True (0 before any set position).  A reset
+    is expressed by a set position carrying the fill value."""
+    return _seg_scan(_latch_op, 0, values, set_mask, axis)
+
+
+def use_sort_tables() -> bool:
+    """Whether per-segment tables are built scatter-free (one position sort +
+    gathers) instead of by XLA scatter.  XLA:TPU serializes scatters into
+    per-element loops — the round-3 on-chip profile's prime suspect — while
+    XLA:CPU handles the unique-index scatters well (the tuned CPU-backend
+    record keeps its byte-identical traces and warm compile cache).
+    ``TEXTBLAST_TABLE_IMPL`` (sort|scatter) pins one; default picks by
+    backend at trace time, mirroring ``_use_shift_scan``."""
+    import os
+
+    impl = os.environ.get("TEXTBLAST_TABLE_IMPL", "")
+    if impl == "sort":
+        return True
+    if impl == "scatter":
+        return False
+    return jax.default_backend() in ("tpu", "axon")
 
 
 def rev(x: jax.Array, axis: int = 1) -> jax.Array:

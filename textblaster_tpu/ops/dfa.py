@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .device import assoc_scan1
+from .device import assoc_scan1, latch_scan, use_sort_tables
 
 __all__ = ["dfa_states", "citation_spans"]
 
@@ -121,10 +121,20 @@ def citation_spans(cps: jax.Array, digit_mask: jax.Array, ws_mask: jax.Array) ->
     last_lb = assoc_scan1(jnp.maximum, np.int32(-1), lb_pos, axis=1)
 
     b, length = cps.shape
+
+    if use_sort_tables():
+        # Scatter-free span fill (the TPU path): spans never overlap ('['
+        # resets the candidate), so position p is inside a span iff the
+        # NEAREST accept at/after p opened at or before p.  A reversed latch
+        # scan carries each accept's span start (biased +1 so 0 = "no accept
+        # follows") back over the positions it covers.
+        start1 = jnp.where(accept, last_lb + 1, 0)
+        na = jnp.flip(latch_scan(jnp.flip(start1, 1), jnp.flip(accept, 1)), 1)
+        return (na > 0) & (positions >= na - 1)
+
     rows = jnp.arange(b, dtype=jnp.int32)[:, None]
     starts = jnp.where(accept, last_lb, -1)
 
-    diff = jnp.zeros((b, length + 1), dtype=jnp.int32)
     flat_start = jnp.where(accept, rows * (length + 1) + starts, b * (length + 1))
     flat_end = jnp.where(accept, rows * (length + 1) + positions + 1, b * (length + 1))
     flat = jnp.zeros(b * (length + 1) + 1, dtype=jnp.int32)

@@ -183,9 +183,12 @@ def pallas_sort_supported() -> bool:
 
 
 def _pallas_ok(b: int, m: int) -> bool:
+    # Upper bound: m=16384 is silicon-proven (round 3); 32768 still fits the
+    # ~8 VMEM row-copies the network needs, 65536 would not — those rows fall
+    # back to lax.sort.
     return (
         pallas_sort_supported()
-        and m >= 128
+        and 128 <= m <= 32768
         and not (m & (m - 1))
         and b % _ROWS == 0
         and b > 0

@@ -374,7 +374,9 @@ class CompiledPipeline:
         def _eval_plans(plans, state, out, get_structure, max_lines, max_words):
             for kind, i, arg in plans:
                 if kind == "langid":
-                    scores, n_grams = langid_scores(state["cps"], state["lengths"])
+                    scores, n_grams = langid_scores(
+                        state["cps"], state["lengths"], mesh=mesh
+                    )
                     out[f"{i}:scores"] = scores
                     out[f"{i}:n_grams"] = n_grams
                 elif kind == "gopher_quality":
@@ -390,7 +392,7 @@ class CompiledPipeline:
                         out[f"{i}:{k}"] = v
                 elif kind == "c4":
                     stats, new_cps, new_lengths = c4_stage(
-                        state["cps"], state["lengths"], arg, max_lines
+                        state["cps"], state["lengths"], arg, max_lines, mesh=mesh
                     )
                     for k, v in stats.items():
                         out[f"{i}:{k}"] = v

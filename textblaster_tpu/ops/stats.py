@@ -48,11 +48,13 @@ from .device import (
     assoc_scan1,
     classify,
     isin_sorted,
+    latch_scan,
     lower_table,
     rev,
     seg_scan_add,
     seg_scan_max,
     seg_scan_or,
+    use_sort_tables,
     utf8_width,
     word_mask,
 )
@@ -152,6 +154,48 @@ def _scatter(values, idx, active, m, fill=0, op="set"):
     else:
         raise ValueError(op)
     return out[:-1].reshape(b, m)
+
+
+# --- Scatter-free table construction (the TPU path) --------------------------
+# XLA:TPU lowers the per-segment scatters above to serialized per-element
+# loops (round-3 on-chip profile: ~13s/batch, TPU_EVIDENCE_r03).  When
+# ``use_sort_tables()`` is on, tables are built instead by ONE sorted
+# compaction of the active positions (the already-tuned VMEM bitonic network)
+# plus a small ``take_along_axis`` gather per value stream.  This requires
+# the active positions' slot keys to enumerate 0..n-1 in row order (gapless)
+# — every gated call site satisfies it by construction and says how.
+
+
+def _rank_positions_many(actives, m, mesh=None):
+    """For each ``[B, L]`` bool mask in ``actives``: positions of its 1st,
+    2nd, ... active element per row, as ``(pos [B, m] int32, real [B, m]
+    bool)``.  All masks share one stacked device sort (rows are independent,
+    exactly like :func:`_sort_runs_many`)."""
+    b, length = actives[0].shape
+    pos = jnp.broadcast_to(jnp.arange(length, dtype=jnp.int32)[None, :], (b, length))
+    keys = [jnp.where(a, pos, _I32_MAX) for a in actives]
+    key = keys[0] if len(keys) == 1 else jnp.concatenate(keys, axis=0)
+    # Pad the row length to a power of two for the Pallas network; padding
+    # carries the invalid key and a safe gather index.
+    padded = 1 << (length - 1).bit_length()
+    if padded != length:
+        key = jnp.pad(key, ((0, 0), (0, padded - length)), constant_values=_I32_MAX)
+    s_key, s_pos = sort2(key, jnp.where(key == _I32_MAX, 0, key), mesh=mesh)
+    if m > s_key.shape[1]:  # more slots than row positions: right-pad invalid
+        extra = m - s_key.shape[1]
+        s_key = jnp.pad(s_key, ((0, 0), (0, extra)), constant_values=_I32_MAX)
+        s_pos = jnp.pad(s_pos, ((0, 0), (0, extra)))
+    outs = []
+    for i in range(len(actives)):
+        blk_key = s_key[i * b : (i + 1) * b, :m]
+        blk_pos = s_pos[i * b : (i + 1) * b, :m]
+        outs.append((blk_pos, blk_key != _I32_MAX))
+    return outs
+
+
+def _gather_table(values, pos, real, fill=0):
+    v = jnp.take_along_axis(values, pos, axis=1)
+    return jnp.where(real, v, jnp.asarray(fill, dtype=values.dtype))
 
 
 class TextStructure(NamedTuple):
@@ -548,10 +592,21 @@ def fineweb_stats(
     line_hash = _poly_hash(cps, li.content, reset)
 
     lc = li.last_content
-    line_chars = _scatter(char_cnt, li.line_id, lc, max_lines)
-    line_bytes = _scatter(byte_cnt, li.line_id, lc, max_lines)
-    line_has_content = _scatter(has_nonws, li.line_id, lc, max_lines) > 0
-    line_hash_t = _scatter(line_hash, li.line_id, lc, max_lines)
+    if use_sort_tables():
+        # Slot j = the j-th line WITH content (blank lines hold no values on
+        # the scatter path either — their slots are pure fills there, and no
+        # consumer below reads slots positionally: validity masks, sums, and
+        # the dup sort are all permutation/gap insensitive).
+        [(tpos, treal)] = _rank_positions_many([lc], max_lines, mesh)
+        line_chars = _gather_table(char_cnt, tpos, treal)
+        line_bytes = _gather_table(byte_cnt, tpos, treal)
+        line_has_content = _gather_table(has_nonws, tpos, treal) > 0
+        line_hash_t = _gather_table(line_hash, tpos, treal)
+    else:
+        line_chars = _scatter(char_cnt, li.line_id, lc, max_lines)
+        line_bytes = _scatter(byte_cnt, li.line_id, lc, max_lines)
+        line_has_content = _scatter(has_nonws, li.line_id, lc, max_lines) > 0
+        line_hash_t = _scatter(line_hash, li.line_id, lc, max_lines)
     # Byte-length mixing, as in gopher_rep's tables (collision discrimination).
     line_hash_t = line_hash_t * jnp.int32(31) + line_bytes
 
@@ -629,31 +684,60 @@ def gopher_rep_stats(
     p_content = in_trim & ~is_sep
     p_start = p_content & (_shift_r(is_sep, False) | at_t0)
 
-    def seg_table(content, start):
-        seg_id = jnp.cumsum(start.astype(jnp.int32), axis=1) - 1
+    def seg_values(content, start):
         end = content & ~_shift_l(content, False)
         h = _poly_hash(cps, content, start)
         by = seg_scan_add(jnp.where(content, utf8_width(cps), 0), start)
-        tbl_h = _scatter(h, seg_id, end, max_segs)
-        tbl_b = _scatter(by, seg_id, end, max_segs)
+        n = jnp.sum(start, axis=1).astype(jnp.int32)
+        return end, h, by, n
+
+    def seg_finish(tbl_h, tbl_b, n):
         # Mix the byte length into the run key: equal strings keep equal
         # keys, while hash-colliding unequal strings of different lengths
         # no longer count as duplicates (ADVICE r2 discrimination note).
         tbl_h = tbl_h * jnp.int32(31) + tbl_b
-        n = jnp.sum(start, axis=1).astype(jnp.int32)
         tbl_valid = jnp.arange(max_segs, dtype=jnp.int32)[None, :] < n[:, None]
         return tbl_h, tbl_b, tbl_valid, n
 
-    lh, lb, lv, n_l = seg_table(l_content, l_start)
-    ph, pb, pv, n_p = seg_table(p_content, p_start)
+    l_end, l_h, l_by, n_l = seg_values(l_content, l_start)
+    p_end, p_h, p_by, n_p = seg_values(p_content, p_start)
+    if use_sort_tables():
+        # Segments are non-empty char runs, so seg ids are gapless 0..n-1 and
+        # slot j == the j-th segment end — identical to the scatter layout.
+        (lr, pr) = _rank_positions_many([l_end, p_end], max_segs, mesh)
+        lh, lb, lv, n_l = seg_finish(
+            _gather_table(l_h, *lr), _gather_table(l_by, *lr), n_l
+        )
+        ph, pb, pv, n_p = seg_finish(
+            _gather_table(p_h, *pr), _gather_table(p_by, *pr), n_p
+        )
+    else:
+        l_sid = jnp.cumsum(l_start.astype(jnp.int32), axis=1) - 1
+        p_sid = jnp.cumsum(p_start.astype(jnp.int32), axis=1) - 1
+        lh, lb, lv, n_l = seg_finish(
+            _scatter(l_h, l_sid, l_end, max_segs),
+            _scatter(l_by, l_sid, l_end, max_segs),
+            n_l,
+        )
+        ph, pb, pv, n_p = seg_finish(
+            _scatter(p_h, p_sid, p_end, max_segs),
+            _scatter(p_by, p_sid, p_end, max_segs),
+            n_p,
+        )
     l_sorted, p_sorted = _sort_runs_many([(lh, lb, lv), (ph, pb, pv)], mesh=mesh)
     l_dup_elems, l_dup_bytes = _dup_counts_sorted(l_sorted)
     p_dup_elems, p_dup_bytes = _dup_counts_sorted(p_sorted)
 
-    # Word tables for n-grams.
+    # Word tables for n-grams (word_idx enumerates valid ends gaplessly, so
+    # the sorted compaction lands each word at its scatter slot).
     valid_end = st.unit_end & st.unit_valid
-    whash = _scatter(st.unit_hash, st.word_idx, valid_end, max_words)
-    wbytes = _scatter(st.unit_bytes, st.word_idx, valid_end, max_words)
+    if use_sort_tables():
+        [(wpos, wreal)] = _rank_positions_many([valid_end], max_words, mesh)
+        whash = _gather_table(st.unit_hash, wpos, wreal)
+        wbytes = _gather_table(st.unit_bytes, wpos, wreal)
+    else:
+        whash = _scatter(st.unit_hash, st.word_idx, valid_end, max_words)
+        wbytes = _scatter(st.unit_bytes, st.word_idx, valid_end, max_words)
     n_words = st.n_words
     widx = jnp.arange(max_words, dtype=jnp.int32)[None, :]
 
@@ -718,7 +802,9 @@ def gopher_rep_stats(
         if kind == "top":
             out[f"top_{n}"] = _top_duplicate_sorted(srt)
         else:
-            dup_min_flags, dup_min_rid = _dup_run_info_sorted(srt, grams[n][2], idx)
+            dup_min_flags, dup_min_rid = _dup_run_info_sorted(
+                srt, grams[n][2], idx, mesh=mesh
+            )
 
     if dup_sizes:
         rest = dup_sizes[1:]
@@ -729,7 +815,7 @@ def gopher_rep_stats(
             if rest:
                 rjobs = [(grams[n][0], idx, grams[n][2]) for n in rest]
                 for n, srt in zip(rest, _sort_runs_many(rjobs, mesh=mesh)):
-                    _, rid_n = _dup_run_info_sorted(srt, grams[n][2], idx)
+                    _, rid_n = _dup_run_info_sorted(srt, grams[n][2], idx, mesh=mesh)
                     walk.append((n, rid_n, grams[n][2], grams[n][1]))
             res = _find_all_dup_bytes_batched(walk)
             return tuple(res[f"dup_{n}"] for n in dup_sizes)
@@ -746,7 +832,9 @@ def gopher_rep_stats(
     return out
 
 
-def _dup_run_info_sorted(sorted_triple, win_valid, idx) -> Tuple[jax.Array, jax.Array]:
+def _dup_run_info_sorted(
+    sorted_triple, win_valid, idx, mesh=None
+) -> Tuple[jax.Array, jax.Array]:
     """``(flags, run_first)`` from a ``(hash, idx)``-sorted window table:
     ``flags`` — "an earlier identical window exists" (a superset of
     find_all_duplicate's dynamic dup test, used as the rarity gate);
@@ -763,7 +851,18 @@ def _dup_run_info_sorted(sorted_triple, win_valid, idx) -> Tuple[jax.Array, jax.
     )
     # Sorted by (hash, idx): the run's first slot holds the minimum index.
     first_in_run = seg_scan_max(jnp.where(run_start, sidx, -(2**30)), run_start)
-    first_occ = _scatter(first_in_run, sidx, is_real, m)
+    if use_sort_tables():
+        # Un-sort by window index instead of scattering: the real entries'
+        # sidx values are exactly 0..n_valid-1 (win_valid is a prefix mask),
+        # so sorting (sidx, first_in_run) restores window order with slot j
+        # holding window j's run id — the scatter layout, fills included.
+        first_occ = sort2(
+            jnp.where(is_real, sidx, _I32_MAX),
+            jnp.where(is_real, first_in_run, 0),
+            mesh=mesh,
+        )[1]
+    else:
+        first_occ = _scatter(first_in_run, sidx, is_real, m)
     return win_valid & (first_occ < idx), first_occ
 
 
@@ -795,15 +894,27 @@ def _find_all_dup_bytes_batched(jobs) -> Dict[str, jax.Array]:
     val = jnp.concatenate([j[2] for j in jobs], axis=0)
     gbs = jnp.concatenate([j[3] for j in jobs], axis=0)
     rows = jnp.arange(rid.shape[0], dtype=jnp.int32)
+    onehot_visited = use_sort_tables()
+    lane = jnp.arange(m, dtype=jnp.int32)[None, :]
 
     def step(carry, xs):
         visited, skip, acc = carry
         rid_c, gb_c, val_c = xs  # [kB] each
         can = (skip == 0) & val_c
-        seen = visited[rows, rid_c] > 0
-        hit = can & seen
+        if onehot_visited:
+            # One-hot compare instead of row gather/scatter: O(kB*m) VPU work
+            # per step, but no serialized dynamic addressing on TPU.
+            oh = lane == rid_c[:, None]
+            seen = jnp.sum(jnp.where(oh, visited, 0), axis=1) > 0
+            hit = can & seen
+            visited = jnp.maximum(
+                visited, (oh & (can & ~seen)[:, None]).astype(jnp.int32)
+            )
+        else:
+            seen = visited[rows, rid_c] > 0
+            hit = can & seen
+            visited = visited.at[rows, rid_c].max((can & ~seen).astype(jnp.int32))
         acc = acc + jnp.where(hit, gb_c, 0)
-        visited = visited.at[rows, rid_c].max((can & ~seen).astype(jnp.int32))
         skip = jnp.where(hit, n_vec - 1, jnp.maximum(skip - 1, 0))
         return (visited, skip, acc), None
 
@@ -921,6 +1032,7 @@ def c4_stage(
     lengths: jax.Array,
     params: C4Params,
     max_lines: int,
+    mesh=None,
 ) -> Tuple[Dict[str, jax.Array], jax.Array, jax.Array]:
     """The C4 quality filter as a device stage (c4_filters.rs:147-295).
 
@@ -971,7 +1083,7 @@ def c4_stage(
         deleted = jnp.zeros_like(mask)
 
     keep1 = (in_line_trim & ~deleted) | li.is_nl
-    c1_cps, c1_len = compact(cps, keep1)
+    c1_cps, c1_len = compact(cps, keep1, mesh=mesh)
 
     # --- per-line checks on the compacted batch ---
     m1 = jnp.arange(length, dtype=jnp.int32)[None, :] < c1_len[:, None]
@@ -980,43 +1092,92 @@ def c4_stage(
     low1 = _lowered(c1_cps, m1)
 
     valid_end1 = st1.unit_end & st1.unit_valid
-    line_words = _scatter(
-        jnp.ones_like(c1_cps), li1.line_id, valid_end1, max_lines, op="add"
-    )
-    line_max_word = _scatter(
-        st1.unit_len, li1.line_id, valid_end1, max_lines, op="max"
-    )
-
-    # Terminal punctuation: last char of each (already trimmed) line.
-    line_last_char = _scatter(c1_cps, li1.line_id, li1.last_content, max_lines)
-    ends_terminal = isin_sorted(line_last_char, jnp.asarray(_END_PUNCT_SET)) & (
-        line_last_char > 0
-    )
     is_dot1 = (c1_cps == ord(".")) & m1
     dot_start1 = is_dot1 & ~_shift_r(is_dot1, False)
     dot_run1 = seg_scan_add(is_dot1.astype(jnp.int32), dot_start1)
-    line_end_dots = _scatter(
-        jnp.where(is_dot1, dot_run1, 0), li1.line_id, li1.last_content, max_lines
-    )
-    ends_ellipsis = line_end_dots >= 3
 
     # Only the UNION of javascript/policy line flags affects line_keep (no
     # per-cause stats are reported), so all patterns share one candidate
     # pass (_pattern_union_starts).
-    zeros_ml = jnp.zeros_like(ends_terminal)
     line_patterns: Tuple[str, ...] = ()
     if params.filter_javascript:
         line_patterns += ("javascript",)
     if params.filter_policy:
         line_patterns += _POLICY
-    if line_patterns:
-        starts = _pattern_union_starts(low1, m1, line_patterns)
-        bad_pattern_line = (
-            _scatter(starts.astype(jnp.int32), li1.line_id, starts, max_lines, op="add")
-            > 0
+    starts = (
+        _pattern_union_starts(low1, m1, line_patterns) if line_patterns else None
+    )
+
+    if use_sort_tables():
+        # Slot j = line id j: every line present in the compacted batch has
+        # exactly one representative char — its '\n', or the row's final
+        # char — in line order, so the sorted compaction reproduces the
+        # scatter slot layout (a final line whose chars all trimmed away has
+        # no slot on either path; its verdict comes from the fills via
+        # ``line_exists`` below).  Per-line values become segmented scans
+        # read at the representative.
+        reset1 = _line_reset(li1, m1)
+        row_last1 = m1 & ~_shift_l(m1, False)
+        rep1 = (li1.is_nl | row_last1) & m1
+        [(lpos1, lreal1)] = _rank_positions_many([rep1], max_lines, mesh)
+        content_set1 = li1.content | reset1
+
+        line_words = _gather_table(
+            seg_scan_add(valid_end1.astype(jnp.int32), reset1), lpos1, lreal1
         )
+        line_max_word = _gather_table(
+            seg_scan_max(jnp.where(valid_end1, st1.unit_len, 0), reset1),
+            lpos1,
+            lreal1,
+        )
+        # "Value at the line's last content char" via a latch over content
+        # positions (a blank line's representative reads the latch cleared
+        # at its line start — the scatter fill).
+        line_last_char = _gather_table(
+            latch_scan(jnp.where(li1.content, c1_cps, 0), content_set1),
+            lpos1,
+            lreal1,
+        )
+        line_end_dots = _gather_table(
+            latch_scan(jnp.where(li1.content & is_dot1, dot_run1, 0), content_set1),
+            lpos1,
+            lreal1,
+        )
+        if starts is not None:
+            bad_pattern_line = (
+                _gather_table(
+                    seg_scan_or(starts.astype(jnp.int32), reset1), lpos1, lreal1
+                )
+                > 0
+            )
+        else:
+            bad_pattern_line = jnp.zeros_like(line_words, dtype=bool)
     else:
-        bad_pattern_line = zeros_ml
+        line_words = _scatter(
+            jnp.ones_like(c1_cps), li1.line_id, valid_end1, max_lines, op="add"
+        )
+        line_max_word = _scatter(
+            st1.unit_len, li1.line_id, valid_end1, max_lines, op="max"
+        )
+        # Terminal punctuation: last char of each (already trimmed) line.
+        line_last_char = _scatter(c1_cps, li1.line_id, li1.last_content, max_lines)
+        line_end_dots = _scatter(
+            jnp.where(is_dot1, dot_run1, 0), li1.line_id, li1.last_content, max_lines
+        )
+        if starts is not None:
+            bad_pattern_line = (
+                _scatter(
+                    starts.astype(jnp.int32), li1.line_id, starts, max_lines, op="add"
+                )
+                > 0
+            )
+        else:
+            bad_pattern_line = jnp.zeros_like(line_words, dtype=bool)
+
+    ends_terminal = isin_sorted(line_last_char, jnp.asarray(_END_PUNCT_SET)) & (
+        line_last_char > 0
+    )
+    ends_ellipsis = line_end_dots >= 3
 
     # Line count comes from the ORIGINAL batch: a final line whose content
     # trimmed away entirely has no chars and no trailing \n in the compacted
@@ -1052,7 +1213,7 @@ def c4_stage(
     keep2 = (li1.content & char_line_keep & m1) | (
         li1.is_nl & char_line_keep & char_keep_later
     )
-    c2_cps, c2_len = compact(c1_cps, keep2)
+    c2_cps, c2_len = compact(c1_cps, keep2, mesh=mesh)
 
     n_sent = sentence_counts(c2_cps, c2_len)
 
