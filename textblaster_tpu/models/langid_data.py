@@ -351,6 +351,273 @@ Fleire vegar vart oversvømde etter det kraftige regnvêret tysdag.
 """,
 }
 
+# Round-4 expansion: news/administrative register (the register the labeled
+# corpus leans on) with the orthography that separates the close pairs laid
+# on thick — Danish ud-/ej/øj/af/-tion/soft-d/-ede vs Bokmål ut-/ei/øy/av/
+# -sjon/-et vs Nynorsk ikkje/kva/vere/-inga, Swedish och/ä/ö.
+_TRAIN_TEXT_3 = {
+    "English": """
+The city council approved new bicycle lanes along the main road into the harbour district.
+Parents have complained about the long waiting lists for kindergarten places.
+Negotiations about next year's fishing quotas begin in Brussels on Monday.
+Residents can comment on the planned wind farm at a public hearing in March.
+The handball team won its third straight match and now leads the league.
+The fire service warns of high risk of forest fires after the dry summer.
+The vaccination campaign starts in October and targets everyone over sixty-five.
+Bus drivers accepted the wage offer after two days of negotiations.
+From January all citizens must use the new digital mailbox for official letters.
+The school board wants to offer free lunch to all pupils from next autumn.
+The toll on the old bridge rises by two kroner at the turn of the year.
+Turnout in the local elections was the highest in twenty years.
+The housing association meets on Wednesday to decide on the roof renovation.
+The municipality opens two new recycling stations on the edge of town.
+Archaeologists found the remains of a medieval trading post under the square.
+The theatre opens its season with a play about a lighthouse keeper's family.
+The chess club arranges an open tournament in the community hall this weekend.
+Heavy snowfall closed the mountain pass for several hours on Wednesday morning.
+The dentist recommends that children brush their teeth twice a day.
+Sales of electric cars rose sharply in the second half of the year.
+The old swimming hall will be torn down when the new one is ready.
+A leaking water pipe flooded the cellar of the town hall during the night.
+The choir rehearses every Tuesday evening in the chapel by the school.
+Customs officers seized a large shipment of counterfeit goods at the border.
+The weather service expects mild days and night frost during the week.
+The union fears that the closure of the sawmill will cost eighty jobs.
+The course teaches older people how to pay bills safely online.
+The apartment needs new wiring before the family can move in.
+Researchers are mapping how the fjord's cod stock has changed over forty years.
+The airline opens a direct route between the two capitals in April.
+""",
+    "Danish": """
+Byrådet godkendte nye cykelstier langs hovedvejen ud mod havnekvarteret.
+Forældre har klaget over de lange ventelister til en plads i børnehaven.
+Forhandlingerne om næste års fiskekvoter begynder i Bruxelles på mandag.
+Borgerne kan kommentere den planlagte vindmøllepark ved et offentligt møde i marts.
+Håndboldholdet vandt sin tredje kamp i træk og fører nu rækken.
+Beredskabet advarer om høj risiko for skovbrande efter den tørre sommer.
+Vaccinationskampagnen begynder i oktober og retter sig mod alle over femogtres.
+Buschaufførerne sagde ja til løntilbuddet efter to dages forhandlinger.
+Fra januar skal alle borgere bruge den nye digitale postkasse til breve fra det offentlige.
+Skolebestyrelsen vil tilbyde gratis frokost til alle elever fra næste efterår.
+Afgiften på den gamle bro stiger med to kroner ved årsskiftet.
+Valgdeltagelsen ved kommunalvalget var den højeste i tyve år.
+Andelsboligforeningen mødes onsdag for at beslutte sig om udskiftningen af taget.
+Kommunen åbner to nye genbrugsstationer i udkanten af byen.
+Arkæologer fandt resterne af en middelalderlig handelsplads under torvet.
+Teatret åbner sæsonen med et stykke om en fyrpassers familie.
+Skakklubben afholder en åben turnering i forsamlingshuset i weekenden.
+Kraftigt snefald lukkede bjergpasset i flere timer onsdag morgen.
+Tandlægen anbefaler, at børn børster tænder to gange om dagen.
+Salget af elbiler steg kraftigt i andet halvår.
+Den gamle svømmehal rives ned, når den nye står færdig.
+Et utæt vandrør satte rådhusets kælder under vand i løbet af natten.
+Koret øver hver tirsdag aften i kapellet ved skolen.
+Tolderne beslaglagde et stort parti forfalskede varer ved grænsen.
+Vejrtjenesten venter milde dage og nattefrost i ugens løb.
+Fagforeningen frygter, at lukningen af savværket vil koste firs arbejdspladser.
+Kurset lærer ældre at betale regninger sikkert på nettet.
+Lejligheden skal have nye elinstallationer, før familien kan flytte ind.
+Forskere kortlægger, hvordan fjordens torskebestand har ændret sig gennem fyrre år.
+Flyselskabet åbner en direkte rute mellem de to hovedstæder i april.
+Rejsen med færgen tager halvanden time, hvis vejret ellers arter sig.
+Udviklingen på boligmarkedet har overrasket de fleste økonomer i år.
+Han øjnede en mulighed for at sælge forretningen, inden afgiften blev sat op.
+Arbejdet med motorvejen er udskudt til efter sommerferien.
+Uden flere penge fra staten må svømmehallen holde lukket hele vinteren.
+""",
+    "Swedish": """
+Kommunfullmäktige godkände nya cykelbanor längs huvudvägen ut mot hamnkvarteren.
+Föräldrar har klagat över de långa väntelistorna till en plats på förskolan.
+Förhandlingarna om nästa års fiskekvoter inleds i Bryssel på måndag.
+Invånarna kan lämna synpunkter på den planerade vindkraftsparken vid ett samråd i mars.
+Handbollslaget vann sin tredje raka match och leder nu serien.
+Räddningstjänsten varnar för hög risk för skogsbränder efter den torra sommaren.
+Vaccinationskampanjen inleds i oktober och riktar sig till alla över sextiofem.
+Busschaufförerna sade ja till lönebudet efter två dagars förhandlingar.
+Från januari måste alla medborgare använda den nya digitala brevlådan för myndighetspost.
+Skolstyrelsen vill erbjuda gratis lunch till alla elever från och med nästa höst.
+Avgiften på den gamla bron höjs med två kronor vid årsskiftet.
+Valdeltagandet i kommunalvalet var det högsta på tjugo år.
+Bostadsrättsföreningen träffas på onsdag för att besluta om takrenoveringen.
+Kommunen öppnar två nya återvinningsstationer i utkanten av staden.
+Arkeologer hittade resterna av en medeltida handelsplats under torget.
+Teatern öppnar säsongen med en pjäs om en fyrvaktares familj.
+Schackklubben ordnar en öppen turnering i bygdegården i helgen.
+Kraftigt snöfall stängde fjällpasset i flera timmar på onsdagsmorgonen.
+Tandläkaren rekommenderar att barn borstar tänderna två gånger om dagen.
+Försäljningen av elbilar ökade kraftigt under andra halvåret.
+Den gamla simhallen rivs när den nya står klar.
+En läckande vattenledning satte stadshusets källare under vatten under natten.
+Kören övar varje tisdagskväll i kapellet vid skolan.
+Tulltjänstemännen beslagtog ett stort parti förfalskade varor vid gränsen.
+Vädertjänsten väntar milda dagar och nattfrost under veckan.
+Facket befarar att nedläggningen av sågverket kostar åttio jobb.
+Kursen lär äldre att betala räkningar säkert på nätet.
+Lägenheten behöver nya elinstallationer innan familjen kan flytta in.
+Forskare kartlägger hur fjordens torskbestånd har förändrats under fyrtio år.
+Flygbolaget öppnar en direktlinje mellan de två huvudstäderna i april.
+""",
+    "Nynorsk": """
+Kommunestyret godkjende nye sykkelvegar langs hovudvegen ut mot hamnekvartala.
+Foreldre har klaga på dei lange ventelistene for å få plass i barnehagen.
+Forhandlingane om fiskekvotane for neste år tek til i Brussel måndag.
+Innbyggjarane kan seie meininga si om den planlagde vindparken på eit ope møte i mars.
+Handballaget vann sin tredje kamp på rad og leier no serien.
+Brannvesenet åtvarar mot høg fare for skogbrann etter den tørre sommaren.
+Vaksinasjonskampanjen tek til i oktober og rettar seg mot alle over sekstifem.
+Bussjåførane sa ja til lønstilbodet etter to dagar med forhandlingar.
+Frå januar må alle innbyggjarar bruke den nye digitale postkassa til brev frå det offentlege.
+Skulestyret vil tilby gratis lunsj til alle elevane frå neste haust.
+Avgifta på den gamle brua aukar med to kroner ved årsskiftet.
+Valdeltakinga ved kommunevalet var den høgaste på tjue år.
+Burettslaget møtest onsdag for å avgjere om taket skal skiftast ut.
+Kommunen opnar to nye gjenvinningsstasjonar i utkanten av byen.
+Arkeologar fann restane av ein mellomaldersk handelsstad under torget.
+Teateret opnar sesongen med eit stykke om familien til ein fyrvaktar.
+Sjakklubben skipar til ei open turnering i grendehuset i helga.
+Kraftig snøfall stengde fjellovergangen i fleire timar onsdag morgon.
+Tannlegen rår til at born pussar tennene to gonger om dagen.
+Salet av elbilar auka kraftig i andre halvår.
+Den gamle symjehallen vert riven når den nye står klar.
+Eit lekk vassrøyr sette kjellaren i rådhuset under vatn i løpet av natta.
+Koret øver kvar tysdagskveld i kapellet ved skulen.
+Tollarane beslagla eit stort parti forfalska varer ved grensa.
+Vêrtenesta ventar milde dagar og nattefrost utover veka.
+Fagforeininga fryktar at nedlegginga av sagbruket vil koste åtti arbeidsplassar.
+Kurset lærer eldre korleis dei betaler rekningar trygt på nettet.
+Leilegheita treng nytt elektrisk anlegg før familien kan flytte inn.
+Forskarar kartlegg korleis torskebestanden i fjorden har endra seg gjennom førti år.
+Flyselskapet opnar ei direkte rute mellom dei to hovudstadene i april.
+""",
+    "Bokmal": """
+Kommunestyret godkjente nye sykkelveier langs hovedveien ut mot havnekvartalene.
+Foreldre har klaget på de lange ventelistene for å få plass i barnehagen.
+Forhandlingene om neste års fiskekvoter begynner i Brussel mandag.
+Innbyggerne kan si sin mening om den planlagte vindparken på et åpent møte i mars.
+Håndballaget vant sin tredje kamp på rad og leder nå serien.
+Brannvesenet advarer mot høy fare for skogbrann etter den tørre sommeren.
+Vaksinasjonskampanjen begynner i oktober og retter seg mot alle over sekstifem.
+Bussjåførene sa ja til lønnstilbudet etter to dager med forhandlinger.
+Fra januar må alle innbyggere bruke den nye digitale postkassen til brev fra det offentlige.
+Skolestyret vil tilby gratis lunsj til alle elevene fra neste høst.
+Avgiften på den gamle brua øker med to kroner ved årsskiftet.
+Valgdeltakelsen ved kommunevalget var den høyeste på tjue år.
+Borettslaget møtes onsdag for å avgjøre om taket skal skiftes ut.
+Kommunen åpner to nye gjenvinningsstasjoner i utkanten av byen.
+Arkeologer fant restene av en middelaldersk handelsplass under torget.
+Teateret åpner sesongen med et stykke om familien til en fyrvokter.
+Sjakklubben arrangerer en åpen turnering i grendehuset i helgen.
+Kraftig snøfall stengte fjellovergangen i flere timer onsdag morgen.
+Tannlegen anbefaler at barn pusser tennene to ganger om dagen.
+Salget av elbiler økte kraftig i andre halvår.
+Den gamle svømmehallen rives når den nye står klar.
+Et lekk vannrør satte kjelleren i rådhuset under vann i løpet av natten.
+Koret øver hver tirsdagskveld i kapellet ved skolen.
+Tollerne beslagla et stort parti forfalskede varer ved grensen.
+Værtjenesten venter milde dager og nattefrost utover uken.
+Fagforeningen frykter at nedleggelsen av sagbruket vil koste åtti arbeidsplasser.
+Kurset lærer eldre hvordan de betaler regninger trygt på nettet.
+Leiligheten trenger nytt elektrisk anlegg før familien kan flytte inn.
+Forskere kartlegger hvordan torskebestanden i fjorden har endret seg gjennom førti år.
+Flyselskapet åpner en direkte rute mellom de to hovedstedene i april.
+Reisen med ferga tar halvannen time hvis været ellers oppfører seg.
+Utviklingen på boligmarkedet har overrasket de fleste økonomene i år.
+Han øynet en mulighet til å selge forretningen før avgiften ble satt opp.
+Arbeidet med motorveien er utsatt til etter sommerferien.
+Uten mer penger fra staten må svømmehallen holde stengt hele vinteren.
+""",
+}
+
 TRAIN_TEXT = {
-    lang: _TRAIN_TEXT_1[lang] + _TRAIN_TEXT_2[lang] for lang in _TRAIN_TEXT_1
+    lang: _TRAIN_TEXT_1[lang] + _TRAIN_TEXT_2[lang] + _TRAIN_TEXT_3[lang]
+    for lang in _TRAIN_TEXT_1
+}
+
+
+# Curated common-vocabulary lexicon (flat weight, not Zipf-ranked): frequent
+# content-word FORMS whose orthography separates the close pairs — Danish
+# ud-/-hed/-tion/skov/fik vs Bokmål ut-/-het/-sjon/skog/fikk vs Nynorsk
+# -inga/kva/ikkje/vart, Swedish -ning/och/ä.  General newspaper vocabulary,
+# not tied to any evaluation fixture.
+EXTRA_WORDS = {
+    "Danish": """af ud op ind ned hen hvad hvor hvordan hvorfor hvornår ikke efter sidste først
+mellem gennem igennem uden inden indenfor udenfor omkring måske allerede altid aldrig
+arbejde arbejdet arbejder arbejdede udvikling udviklingen udstilling udstillingen uddannelse uddannelsen
+undersøgelse undersøgelsen oplysning oplysninger mulighed muligheden muligheder sundhed sundheden
+sygdom sygdommen sygehus sygehuset lejlighed lejligheden samfund samfundet videnskab videnskaben
+århundrede århundredet tyve tredive fyrre halvtreds tres halvfjerds firs halvfems
+fik fået får gik gået går stod stået står så set ser blev blevet bliver
+opdaget opdagede oplevede oplevet fortalte fortalt talte talt solgte solgt købte købt
+skov skoven skove vej vejen veje nej sejr øje øjne høj højere højest
+gade gaden uge ugen måned måneden tid tiden sted steder by byen
+regering regeringen miljø miljøet kærlighed samarbejde virksomhed virksomheder myndighed myndigheder
+spørgsmål svar løsning løsninger forskning forskningen udgift udgifter indtægt indtægter
+næste stor store større størst lille små mindre mindst god bedre bedst
+dreng pige mand kvinde barn børn menneske mennesker ven venner
+sundhedsvæsen sundhedsvæsenet hovedstaden udlandet indbygger indbyggere
+anmeldelse anmeldelser biograf biografen biograferne avis avisen aviser""",
+    "Bokmal": """av ut opp inn ned bort hva hvor hvordan hvorfor når ikke etter siste først
+mellom gjennom uten innen innenfor utenfor omkring kanskje allerede alltid aldri
+arbeid arbeidet arbeider utvikling utviklingen utstilling utstillingen utdanning utdanningen
+undersøkelse undersøkelsen opplysning opplysninger mulighet muligheten muligheter helse helsen
+sykdom sykdommen sykehus sykehuset leilighet leiligheten samfunn samfunnet vitenskap vitenskapen
+århundre århundret tjue tretti førti femti seksti sytti åtti nitti
+fikk fått får gikk gått går sto stått står så sett ser ble blitt blir
+oppdaget opplevde opplevd fortalte fortalt snakket solgte solgt kjøpte kjøpt
+skog skogen skoger vei veien veier nei seier øye øyne høy høyere høyest
+gate gaten uke uken måned måneden tid tiden sted steder by byen
+regjering regjeringen miljø miljøet kjærlighet samarbeid virksomhet virksomheter myndighet myndigheter
+spørsmål svar løsning løsninger forskning forskningen utgift utgifter inntekt inntekter
+neste stor store større størst liten små mindre minst god bedre best
+gutt jente mann kvinne barn mennesker venn venner
+helsevesen helsevesenet hovedstaden utlandet innbygger innbyggere
+anmeldelse anmeldelser kino kinoen avis avisen aviser""",
+    "Nynorsk": """av ut opp inn ned bort kva kvar korleis kvifor når ikkje etter siste først
+mellom gjennom utan innan innanfor utanfor omkring kanskje allereie alltid aldri
+arbeid arbeidet arbeider utvikling utviklinga utstilling utstillinga utdanning utdanninga
+undersøking undersøkinga opplysning opplysningar moglegheit høve helse helsa
+sjukdom sjukdommen sjukehus sjukehuset leilegheit leilegheita samfunn samfunnet vitskap vitskapen
+hundreår hundreåret tjue tretti førti femti seksti sytti åtti nitti
+fekk fått får gjekk gått går sto stått står såg sett ser vart blitt blir vert
+oppdaga opplevde opplevd fortalde fortalt snakka selde selt kjøpte kjøpt
+skog skogen skogar veg vegen vegar nei siger auge augo høg høgare høgast
+gate gata veke veka månad månaden tid tida stad stader by byen
+regjering regjeringa miljø miljøet kjærleik samarbeid verksemd verksemder styresmakt styresmakter
+spørsmål svar løysing løysingar forsking forskinga utgift utgifter inntekt inntekter
+neste stor store større størst liten små mindre minst god betre best
+gut jente mann kvinne barn born menneske menneska venn venner
+helsevesen helsevesenet hovudstaden utlandet innbyggjar innbyggjarar
+melding meldingar kino kinoen avis avisa aviser""",
+    "Swedish": """av ut upp in ner bort vad var hur varför när inte efter sista först
+mellan genom utan inom innanför utanför omkring kanske redan alltid aldrig
+arbete arbetet arbetar utveckling utvecklingen utställning utställningen utbildning utbildningen
+undersökning undersökningen upplysning upplysningar möjlighet möjligheten möjligheter hälsa hälsan
+sjukdom sjukdomen sjukhus sjukhuset lägenhet lägenheten samhälle samhället vetenskap vetenskapen
+århundrade århundradet tjugo trettio fyrtio femtio sextio sjuttio åttio nittio
+fick fått får gick gått går stod stått står såg sett ser blev blivit blir
+upptäckte upptäckt upplevde upplevt berättade berättat pratade sålde sålt köpte köpt
+skog skogen skogar väg vägen vägar nej seger öga ögon hög högre högst
+gata gatan vecka veckan månad månaden tid tiden plats platser stad staden
+regering regeringen miljö miljön kärlek samarbete verksamhet verksamheter myndighet myndigheter
+fråga frågor svar lösning lösningar forskning forskningen utgift utgifter inkomst inkomster
+nästa stor stora större störst liten små mindre minst god bättre bäst
+pojke flicka man kvinna barn människa människor vän vänner
+sjukvård sjukvården huvudstaden utlandet invånare
+recension recensioner bio bion biograf tidning tidningen tidningar""",
+    "English": """of out up in down away what where how why when not after last first
+between through without inside outside around maybe already always never
+work worked working development exhibition education examination
+investigation information possibility opportunity health healthcare
+sickness illness hospital apartment society science
+century twenty thirty forty fifty sixty seventy eighty ninety
+got gotten gets went gone goes stood stands saw seen sees became become becomes
+discovered experienced told talked sold bought
+forest forests road roads no victory eye eyes high higher highest
+street week month time place city town
+government environment love cooperation business authority authorities
+question answer solution research expense income
+next big bigger biggest little small smaller smallest good better best
+boy girl man woman child children person people friend friends
+capital abroad inhabitant inhabitants
+review reviews cinema newspaper newspapers""",
 }

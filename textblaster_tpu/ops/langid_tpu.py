@@ -21,7 +21,7 @@ import jax.numpy as jnp
 from ..models.langid import TABLE_SIZE, get_model
 from .compact import compact
 from .device import ALPHA, classify, lower_table
-from .stats import _shift_r
+from .stats import _poly_hash, _shift_l, _shift_r
 
 __all__ = ["langid_scores"]
 
@@ -30,7 +30,9 @@ def _table_q() -> jax.Array:
     return jnp.asarray(get_model().table_q)  # [TABLE_SIZE, 5] int32
 
 
-def langid_scores(cps: jax.Array, lengths: jax.Array) -> Tuple[jax.Array, jax.Array]:
+def langid_scores(
+    cps: jax.Array, lengths: jax.Array, mesh=None
+) -> Tuple[jax.Array, jax.Array]:
     """Per-document quantized language scores.
 
     Returns ``(scores_q [B, 5] int32, n_grams [B] int32)``; rows with
@@ -50,7 +52,7 @@ def langid_scores(cps: jax.Array, lengths: jax.Array) -> Tuple[jax.Array, jax.Ar
     first_of_run = nonletter & ~_shift_r(nonletter, False)
     keep = letter | first_of_run
     vals = jnp.where(letter, low, 0)
-    norm, nlen = compact(vals, keep)
+    norm, nlen = compact(vals, keep, mesh=mesh)
 
     # Leading boundary: prepend 0 unless the stream already starts with one.
     starts_with_letter = norm[:, 0] != 0
@@ -75,9 +77,24 @@ def langid_scores(cps: jax.Array, lengths: jax.Array) -> Tuple[jax.Array, jax.Ar
     tri_valid = (
         jnp.arange(length, dtype=jnp.int32)[None, :] < jnp.maximum(nlen - 2, 0)[:, None]
     )
-    rows = _table_q()[h]  # [B, L, 5]
+    table = _table_q()
+    rows = table[h]  # [B, L, 5]
     scores = jnp.sum(
         jnp.where(tri_valid[..., None], rows, 0), axis=1, dtype=jnp.int32
     )
-    n_grams = jnp.maximum(nlen - 2, 0).astype(jnp.int32)
+
+    # Whole-word hash features (models.langid._word_hash_vec twin): the
+    # rolling hash h = h*31 + c of each boundary-delimited word, via the
+    # shared segmented affine scan; int32 wraparound == the host's mod 2^32.
+    in_word = norm != 0  # zero-padded past nlen, so no extra mask needed
+    word_start = in_word & ~_shift_r(in_word, False)
+    word_end = in_word & ~_shift_l(in_word, False)
+    wh = _poly_hash(norm, in_word, word_start) & (TABLE_SIZE - 1)
+    wrows = table[wh]  # [B, L, 5]
+    scores = scores + jnp.sum(
+        jnp.where(word_end[..., None], wrows, 0), axis=1, dtype=jnp.int32
+    )
+    n_words = jnp.sum(word_end, axis=1).astype(jnp.int32)
+
+    n_grams = (jnp.maximum(nlen - 2, 0) + n_words).astype(jnp.int32)
     return scores, n_grams
