@@ -1,12 +1,14 @@
-"""Multi-host execution test: 2 coordinated processes on CPU devices.
+"""Multi-host execution test: 2 coordinated CLI processes on CPU devices.
 
-The CPU stand-in for a 2-host pod (SURVEY.md §4: multi-chip tests via
-forced host-platform device counts): two OS processes join one
-``jax.distributed`` coordinator, each feeds its own half of a document shard
-into the globally-sharded compiled pipeline
-(``textblaster_tpu/parallel/multihost.py``), and each emits outcomes for its
-local documents.  The merged outcomes must be bit-identical to the host
-oracle over the full shard.
+The CPU stand-in for a 2-host pod (SURVEY.md §4: multi-chip tests via forced
+host-platform device counts): two OS processes each run the production entry
+``textblast run --coordinator ... --num-processes 2 --process-id i`` against
+the SAME input Parquet.  Each reads its row stripe, rounds are negotiated by
+allgather (no operator budget), each writes a per-host shard pair, and
+process 0 merges them into the final kept/excluded Parquet files
+(``textblaster_tpu/parallel/multihost.py:run_multihost``).  The merged
+outputs must be decision- and metadata-identical to the host oracle over the
+full shard.
 """
 
 from __future__ import annotations
@@ -18,6 +20,8 @@ import sys
 from pathlib import Path
 
 import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
 import pytest
 
 from textblaster_tpu.config.pipeline import parse_pipeline_config
@@ -56,6 +60,10 @@ def _docs():
         "kort.",
         "Endnu en dansk tekst om vejret, og den er ganske lang og fin at læse.",
         "Vi mødes nede ved havnen i morgen, og så sejler vi ud på vandet.",
+        # One long doc exercising the second bucket of the negotiated
+        # multi-bucket schedule.
+        ("En meget lang dansk tekst om byen og havnen og vejret, og den "
+         "bliver ved i mange ord. ") * 12,
     ]
     rng = np.random.default_rng(11)
     docs = []
@@ -73,20 +81,28 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_distributed_run_matches_oracle(tmp_path: Path):
+def test_two_process_cli_run_matches_oracle(tmp_path: Path):
     docs = _docs()
-    halves = [docs[::2], docs[1::2]]
     cfg = tmp_path / "cfg.yaml"
     cfg.write_text(YAML, encoding="utf-8")
+    inp = tmp_path / "input.parquet"
+    pq.write_table(
+        pa.table(
+            {
+                "id": [d.id for d in docs],
+                "text": [d.content for d in docs],
+                "source": [d.source for d in docs],
+            }
+        ),
+        inp,
+    )
+    out = tmp_path / "kept.parquet"
+    exc = tmp_path / "excluded.parquet"
     port = _free_port()
 
     procs = []
     try:
         for pid in (0, 1):
-            inp = tmp_path / f"in{pid}.jsonl"
-            inp.write_text(
-                "".join(d.to_json() + "\n" for d in halves[pid]), encoding="utf-8"
-            )
             env = {
                 "JAX_PLATFORMS": "cpu",
                 "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
@@ -96,16 +112,16 @@ def test_two_process_distributed_run_matches_oracle(tmp_path: Path):
             procs.append(
                 subprocess.Popen(
                     [
-                        sys.executable, "-m",
-                        "textblaster_tpu.parallel.multihost",
+                        sys.executable, "-m", "textblaster_tpu.cli", "run",
                         "--coordinator", f"localhost:{port}",
                         "--num-processes", "2",
                         "--process-id", str(pid),
-                        "--pipeline-config", str(cfg),
-                        "--input-jsonl", str(inp),
-                        "--output-jsonl", str(tmp_path / f"out{pid}.jsonl"),
-                        "--bucket", "512",
-                        "--rounds", "1",
+                        "-i", str(inp),
+                        "-o", str(out),
+                        "-e", str(exc),
+                        "-c", str(cfg),
+                        "--buckets", "512,2048",
+                        "--quiet",
                     ],
                     cwd=str(Path(__file__).parent.parent),
                     env=env,
@@ -116,32 +132,41 @@ def test_two_process_distributed_run_matches_oracle(tmp_path: Path):
             )
         outputs = []
         for p in procs:
-            out, _ = p.communicate(timeout=560)
-            outputs.append(out)
+            o, _ = p.communicate(timeout=560)
+            outputs.append(o)
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
-    for p, out in zip(procs, outputs):
-        assert p.returncode == 0, out[-2000:]
+    for p, o in zip(procs, outputs):
+        assert p.returncode == 0, o[-2000:]
 
-    merged = {}
-    for pid in (0, 1):
-        for line in (tmp_path / f"out{pid}.jsonl").read_text().splitlines():
-            if line.strip():
-                o = ProcessingOutcome.from_json(line)
-                merged[o.document.id] = o
+    # Shards are merged and removed by process 0.
+    assert out.exists() and exc.exists()
+    assert not list(tmp_path.glob("*.shard*"))
+
+    def rows(path):
+        t = pq.read_table(path).to_pylist()
+        return {
+            r["id"]: (r["text"], json.loads(r["metadata"]) if r["metadata"] else {})
+            for r in t
+        }
+
+    kept, excluded = rows(out), rows(exc)
+    assert not (set(kept) & set(excluded))
 
     config = parse_pipeline_config(YAML)
-    host = {
-        o.document.id: o
-        for o in process_documents_host(
-            build_pipeline_from_config(config), iter(_docs())
-        )
-    }
-    assert set(merged) == set(host)
-    for k, ho in host.items():
-        mo = merged[k]
-        assert mo.kind == ho.kind, (k, mo.kind, ho.kind)
-        assert mo.reason == ho.reason, k
-        assert mo.document.metadata == ho.document.metadata, k
+    host_kept, host_exc = {}, {}
+    for o in process_documents_host(build_pipeline_from_config(config), iter(_docs())):
+        d = o.document
+        if o.kind == ProcessingOutcome.SUCCESS:
+            host_kept[d.id] = (d.content, d.metadata)
+        elif o.kind == ProcessingOutcome.FILTERED:
+            host_exc[d.id] = (d.content, d.metadata)
+
+    assert set(kept) == set(host_kept)
+    assert set(excluded) == set(host_exc)
+    for k, v in host_kept.items():
+        assert kept[k] == v, k
+    for k, v in host_exc.items():
+        assert excluded[k] == v, k

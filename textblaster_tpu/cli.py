@@ -72,6 +72,16 @@ def build_parser() -> argparse.ArgumentParser:
                           "chunk (the reference cannot do this)")
     run.add_argument("--checkpoint-every", type=int, default=8192,
                      help="Documents per checkpointed chunk")
+    run.add_argument("--coordinator", default=None,
+                     help="host:port of process 0 — enables the multi-host "
+                          "SPMD path: every process runs this same command "
+                          "with its own --process-id, reads its row stripe, "
+                          "and process 0 merges the per-host output shards "
+                          "(the AMQP-address analogue, utils/common.rs:15)")
+    run.add_argument("--num-processes", type=int, default=1,
+                     help="Total participating processes (with --coordinator)")
+    run.add_argument("--process-id", type=int, default=0,
+                     help="This process's rank (with --coordinator)")
 
     val = sub.add_parser("validate-config",
                          help="Validate a pipeline configuration and exit")
@@ -137,8 +147,39 @@ def _cmd_run(args: argparse.Namespace) -> int:
     start = time.perf_counter()
     fallbacks_before = METRICS.get("worker_host_fallback_total")
 
+    if args.coordinator and args.checkpoint_dir:
+        print("--coordinator and --checkpoint-dir are mutually exclusive "
+              "(multihost runs restart per shard; use smaller input stripes "
+              "for resumability)", file=sys.stderr)
+        return 1
+    if args.coordinator and args.backend == "host":
+        print("--coordinator requires the compiled pipeline "
+              "(--backend tpu or cpu, not host)", file=sys.stderr)
+        return 1
+
     try:
-        if args.checkpoint_dir:
+        if args.coordinator:
+            from .parallel.multihost import run_multihost
+
+            mh_kwargs = {}
+            if buckets:
+                mh_kwargs["buckets"] = buckets
+            if args.device_batch:
+                mh_kwargs["device_batch"] = args.device_batch
+            result = run_multihost(
+                config,
+                args.input_file,
+                args.output_file,
+                args.excluded_file,
+                coordinator=args.coordinator,
+                num_processes=args.num_processes,
+                process_id=args.process_id,
+                text_column=args.text_column,
+                id_column=args.id_column,
+                read_batch_size=args.batch_size,
+                **mh_kwargs,
+            )
+        elif args.checkpoint_dir:
             from .checkpoint import run_checkpointed
             from .parallel.runner import _Progress
 

@@ -12,11 +12,17 @@ addressable output shards (the results-queue analogue: outputs land where
 the documents came from, ready for per-host Parquet shards).
 
 Lockstep contract: multi-host SPMD requires every process to dispatch the
-same programs in the same order, so a run uses ONE bucket length and a fixed
-number of rounds; hosts with fewer documents pad with empty batches.  The
-driver entry (``python -m textblaster_tpu.parallel.multihost``) and
-``tests/test_multihost.py`` demonstrate a 2-process run on CPU devices and
-check bit-parity against the host oracle.
+same programs in the same order.  The per-(bucket) round counts are therefore
+**negotiated**: every process allgathers how many rounds each bucket needs for
+its local documents, and all processes run the columnwise maximum — hosts
+with fewer documents pad with empty batches.  No operator-supplied round
+budget is needed (the round-3 ``rounds`` argument survives as an optional
+assertion).  ``textblast run --coordinator ... --num-processes N
+--process-id i`` is the production entry (:func:`run_multihost`): each
+process reads its row stripe of the input Parquet, writes a per-host shard
+pair, and host 0 merges the shards into the final kept/excluded files after
+a global barrier — the "resharded static fan-out" SURVEY.md §2.5 maps the
+reference's competing consumers onto.
 
 On real pods the same code runs unchanged: ``initialize()`` picks up the TPU
 coordinator, the mesh spans the slice, and ICI/DCN routing is XLA's choice —
@@ -26,7 +32,7 @@ no NCCL/MPI analogue to manage (SURVEY.md §2.5's north-star mapping).
 from __future__ import annotations
 
 import math
-from typing import Iterable, List, Optional, Sequence
+from typing import Iterable, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -36,7 +42,12 @@ from ..data_model import ProcessingOutcome, TextDocument
 from ..ops.packing import pack_documents
 from .mesh import DATA_AXIS, batch_sharding
 
-__all__ = ["initialize", "global_data_mesh", "run_local_shard"]
+__all__ = [
+    "initialize",
+    "global_data_mesh",
+    "run_local_shard",
+    "run_multihost",
+]
 
 
 def initialize(
@@ -81,21 +92,41 @@ def _local_stats(out: dict) -> dict:
     }
 
 
+def _negotiate_max(needed_local: np.ndarray) -> np.ndarray:
+    """Columnwise max of every process's per-bucket round counts.
+
+    Lockstep safety: EVERY process must run the same number of rounds per
+    bucket — a unilateral decision while peers enter ``fn()`` would hang the
+    job until the coordinator heartbeat tears it down.  One small allgather
+    makes the schedule global and deterministic."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        needed_all = multihost_utils.process_allgather(
+            needed_local.astype(np.int32)
+        ).reshape(-1, needed_local.shape[0])
+        return needed_all.max(axis=0)
+    return needed_local.astype(np.int32)
+
+
 def run_local_shard(
     config: PipelineConfig,
     docs: Sequence[TextDocument],
-    bucket: int,
-    rounds: int,
+    bucket: Optional[int] = None,
+    rounds: Optional[int] = None,
     mesh=None,
     pipeline=None,
+    buckets: Optional[Sequence[int]] = None,
 ) -> List[ProcessingOutcome]:
     """Run this host's documents through the globally-sharded pipeline.
 
-    Every participating process must call this with the same ``config``,
-    ``bucket`` and ``rounds`` (lockstep); ``rounds`` must satisfy
-    ``rounds * local_batch >= len(docs)`` on every host, where
-    ``local_batch = global_batch / num_processes``.  Documents longer than
-    the bucket run the host oracle locally (the usual counted fallback).
+    Every participating process must call this with the same ``config`` and
+    bucket set (lockstep).  The number of rounds per bucket is negotiated by
+    allgather (:func:`_negotiate_max`), so hosts never need a pre-agreed
+    budget; passing ``rounds`` turns it into an assertion (ValueError if the
+    negotiated schedule exceeds it — the round-3 interface).  Documents
+    longer than every bucket run the host oracle locally (the usual counted
+    fallback).
 
     Returns outcomes for **this host's** documents only.
     """
@@ -105,51 +136,53 @@ def run_local_shard(
 
     from ..ops.packing import PACK_MARGIN
 
+    if buckets is None:
+        buckets = (bucket,) if bucket is not None else (2048,)
+    buckets = tuple(sorted(buckets))
     mesh = mesh if mesh is not None else global_data_mesh()
     n_proc = jax.process_count()
     if pipeline is None:
-        pipeline = CompiledPipeline(config, buckets=(bucket,), mesh=mesh)
+        pipeline = CompiledPipeline(config, buckets=buckets, mesh=mesh)
     local_batch = pipeline.batch_size // n_proc
 
-    fits, fallback = [], []
+    fits: dict = {b: [] for b in buckets}
+    fallback: List[TextDocument] = []
     for d in docs:
-        (fits if len(d.content) <= bucket - PACK_MARGIN else fallback).append(d)
-    # Lockstep safety: EVERY process must agree the round budget is enough —
-    # a unilateral raise here while peers enter fn() would hang the job until
-    # the coordinator heartbeat tears it down.  One small allgather makes the
-    # failure synchronous and attributable.
-    needed_local = math.ceil(len(fits) / local_batch)
-    if n_proc > 1:
-        from jax.experimental import multihost_utils
+        for b in buckets:
+            if len(d.content) <= b - PACK_MARGIN:
+                fits[b].append(d)
+                break
+        else:
+            fallback.append(d)
 
-        needed_all = multihost_utils.process_allgather(
-            np.array([needed_local], dtype=np.int32)
-        ).reshape(-1)
-        needed = int(needed_all.max())
-    else:
-        needed = needed_local
-    if needed > rounds:
+    needed_local = np.array(
+        [math.ceil(len(fits[b]) / local_batch) for b in buckets], dtype=np.int32
+    )
+    schedule = _negotiate_max(needed_local)
+    if rounds is not None and int(schedule.sum()) > rounds:
         raise ValueError(
-            f"shard needs {needed} rounds (local {needed_local}), got {rounds}"
+            f"shard needs {int(schedule.sum())} rounds "
+            f"(local {int(needed_local.sum())}), got {rounds}"
         )
 
     sh2 = batch_sharding(mesh, 2)
     sh1 = batch_sharding(mesh, 1)
-    fn = pipeline._fn_for(bucket)
 
     outcomes: List[ProcessingOutcome] = []
     pending = None  # (local_batch, device_out): one round in flight
-    for r in range(rounds):
-        chunk = fits[r * local_batch : (r + 1) * local_batch]
-        local = pack_documents(chunk, batch_size=local_batch, max_len=bucket)
-        g_cps = jax.make_array_from_process_local_data(sh2, local.cps)
-        g_len = jax.make_array_from_process_local_data(sh1, local.lengths)
-        out = fn(g_cps, g_len)
-        if pending is not None:
-            outcomes.extend(
-                pipeline.assemble_batch(pending[0], _local_stats(pending[1]))
-            )
-        pending = (local, out)
+    for b, n_rounds in zip(buckets, schedule):
+        fn = pipeline._fn_for(b)
+        for r in range(int(n_rounds)):
+            chunk = fits[b][r * local_batch : (r + 1) * local_batch]
+            local = pack_documents(chunk, batch_size=local_batch, max_len=b)
+            g_cps = jax.make_array_from_process_local_data(sh2, local.cps)
+            g_len = jax.make_array_from_process_local_data(sh1, local.lengths)
+            out = fn(g_cps, g_len)
+            if pending is not None:
+                outcomes.extend(
+                    pipeline.assemble_batch(pending[0], _local_stats(pending[1]))
+                )
+            pending = (local, out)
     if pending is not None:
         outcomes.extend(
             pipeline.assemble_batch(pending[0], _local_stats(pending[1]))
@@ -163,13 +196,130 @@ def run_local_shard(
     return outcomes
 
 
-def _main(argv: Optional[Sequence[str]] = None) -> int:
-    """Per-process driver: JSONL docs in, JSONL outcomes out.
+def run_multihost(
+    config: PipelineConfig,
+    input_file: str,
+    output_file: str,
+    excluded_file: str,
+    *,
+    coordinator: str,
+    num_processes: int,
+    process_id: int,
+    text_column: str = "text",
+    id_column: str = "id",
+    buckets: Sequence[int] = (512, 2048, 8192),
+    read_batch_size: int = 1024,
+    device_batch: Optional[int] = None,
+):
+    """Production multi-host entry (``textblast run --coordinator ...``).
 
-    The 2-process form (one per "host") is the CPU stand-in for a multi-host
-    pod — see tests/test_multihost.py."""
+    Each process reads its contiguous row stripe of ``input_file`` (the
+    static shard assignment SURVEY.md §2.5 maps the task queue onto), runs
+    the negotiated lockstep schedule, and writes a per-host
+    ``<output>.shard<i>`` / ``<excluded>.shard<i>`` Parquet pair.  After a
+    global barrier, process 0 concatenates the shards into the final
+    kept/excluded files (the results-queue aggregation analogue,
+    producer_logic.rs:109-196) and deletes the shard files.
+
+    Returns an ``AggregationResult``: global totals on process 0 (after the
+    merge), local totals elsewhere.
+    """
+    import os
+    from itertools import islice
+
+    import pyarrow.parquet as pq
+
+    from ..errors import PipelineError
+    from ..orchestration import (
+        AggregationResult,
+        aggregate_results_from_stream,
+        read_documents,
+    )
+
+    initialize(coordinator, num_processes, process_id)
+    mesh = global_data_mesh()
+
+    n_rows = pq.ParquetFile(input_file).metadata.num_rows
+    stride = math.ceil(n_rows / max(num_processes, 1))
+    skip = min(process_id * stride, n_rows)
+    take = max(0, min(stride, n_rows - skip))
+
+    read_errors = 0
+    docs: List[TextDocument] = []
+    stream = read_documents(
+        input_file,
+        text_column=text_column,
+        id_column=id_column,
+        batch_size=read_batch_size,
+        skip_rows=skip,
+    )
+    for item in islice(stream, take):  # one stream item per Parquet row
+        if isinstance(item, PipelineError):
+            read_errors += 1
+        else:
+            docs.append(item)
+
+    from ..ops.pipeline import CompiledPipeline
+
+    pipeline = CompiledPipeline(
+        config, buckets=tuple(sorted(buckets)), batch_size=device_batch or 256,
+        mesh=mesh,
+    )
+    outcomes = run_local_shard(
+        config, docs, buckets=buckets, mesh=mesh, pipeline=pipeline
+    )
+
+    shard_out = f"{output_file}.shard{process_id}"
+    shard_exc = f"{excluded_file}.shard{process_id}"
+    result = aggregate_results_from_stream(iter(outcomes), shard_out, shard_exc)
+    result.read_errors = read_errors
+
+    totals = np.array(
+        [result.received, result.success, result.filtered, result.errors,
+         result.read_errors],
+        dtype=np.int64,
+    )
+    if num_processes > 1:
+        from jax.experimental import multihost_utils
+
+        # Barrier doubling as the totals exchange: every process must have
+        # closed its shard files before process 0 merges.
+        all_totals = multihost_utils.process_allgather(totals).reshape(-1, 5)
+    else:
+        all_totals = totals.reshape(1, 5)
+
+    if process_id == 0:
+        for final, shards in (
+            (output_file, [f"{output_file}.shard{i}" for i in range(num_processes)]),
+            (excluded_file, [f"{excluded_file}.shard{i}" for i in range(num_processes)]),
+        ):
+            # Stream row groups shard by shard: the merge stays O(row-group)
+            # memory however large the global corpus is.
+            writer = None
+            try:
+                for s in shards:
+                    pf = pq.ParquetFile(s)
+                    if writer is None:
+                        writer = pq.ParquetWriter(final, pf.schema_arrow)
+                    for g in range(pf.metadata.num_row_groups):
+                        writer.write_table(pf.read_row_group(g))
+            finally:
+                if writer is not None:
+                    writer.close()
+            for s in shards:
+                os.remove(s)
+        g = all_totals.sum(axis=0)
+        merged = AggregationResult()
+        merged.received, merged.success, merged.filtered = int(g[0]), int(g[1]), int(g[2])
+        merged.errors, merged.read_errors = int(g[3]), int(g[4])
+        return merged
+    return result
+
+
+def _main(argv: Optional[Sequence[str]] = None) -> int:
+    """Per-process module entry — a thin alias for
+    ``textblast run --coordinator ...`` (the production path, `cli.py`)."""
     import argparse
-    import json
 
     from ..config.pipeline import load_pipeline_config
 
@@ -178,26 +328,28 @@ def _main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--num-processes", type=int, required=True)
     ap.add_argument("--process-id", type=int, required=True)
     ap.add_argument("--pipeline-config", required=True)
-    ap.add_argument("--input-jsonl", required=True)
-    ap.add_argument("--output-jsonl", required=True)
-    ap.add_argument("--bucket", type=int, default=2048)
-    ap.add_argument("--rounds", type=int, required=True)
+    ap.add_argument("-i", "--input-file", required=True)
+    ap.add_argument("-o", "--output-file", required=True)
+    ap.add_argument("-e", "--excluded-file", required=True)
+    ap.add_argument("--buckets", default="512,2048,8192")
+    ap.add_argument("--device-batch", type=int, default=None)
     args = ap.parse_args(argv)
 
-    initialize(args.coordinator, args.num_processes, args.process_id)
     config = load_pipeline_config(args.pipeline_config)
-    docs = []
-    with open(args.input_jsonl, encoding="utf-8") as f:
-        for line in f:
-            if line.strip():
-                docs.append(TextDocument.from_json(line))
-    outcomes = run_local_shard(config, docs, bucket=args.bucket, rounds=args.rounds)
-    with open(args.output_jsonl, "w", encoding="utf-8") as f:
-        for o in outcomes:
-            f.write(o.to_json() + "\n")
+    result = run_multihost(
+        config,
+        args.input_file,
+        args.output_file,
+        args.excluded_file,
+        coordinator=args.coordinator,
+        num_processes=args.num_processes,
+        process_id=args.process_id,
+        buckets=tuple(int(b) for b in args.buckets.split(",")),
+        device_batch=args.device_batch,
+    )
     print(
-        f"process {args.process_id}: {len(docs)} docs in, "
-        f"{len(outcomes)} outcomes out"
+        f"process {args.process_id}: {result.received} outcomes "
+        f"({result.success} kept, {result.filtered} excluded)"
     )
     return 0
 
