@@ -1,10 +1,10 @@
-"""C4BadWords device kernel: candidate semantics + end-to-end parity.
+"""C4BadWords device kernel: exact match semantics + end-to-end parity.
 
-The device path must flag every document the reference's alternation regex
-(c4_filters.rs:431-447) would match (no false negatives); the host filter
-then re-verifies flagged documents, so final decisions match the host
-executor exactly.
-"""
+The device path delivers the regex-match verdict itself (double rolling
+hash, ops/badwords.py): every document the reference's alternation regex
+(c4_filters.rs:431-447) matches is flagged, non-matching documents never
+touch the host filter, and matched documents only draw the seeded
+keep-fraction on the host (VERDICT r3 item 6)."""
 
 import numpy as np
 import jax.numpy as jnp
@@ -13,7 +13,7 @@ import pytest
 from textblaster_tpu.config.pipeline import parse_pipeline_config
 from textblaster_tpu.data_model import ProcessingOutcome, TextDocument
 from textblaster_tpu.filters.c4_badwords import load_local_badwords
-from textblaster_tpu.ops.badwords import BadwordTables, badwords_candidates
+from textblaster_tpu.ops.badwords import BadwordTables, badwords_matches
 from textblaster_tpu.ops.pipeline import CompiledPipeline, process_documents_device
 from textblaster_tpu.orchestration import process_documents_host
 from textblaster_tpu.pipeline_builder import build_pipeline_from_config
@@ -42,19 +42,20 @@ def test_candidates_with_boundaries():
         "bad",                         # the whole row
         "",                            # empty row
     ]
-    got = np.asarray(badwords_candidates(*_pack(texts), tables))
+    got = np.asarray(badwords_matches(*_pack(texts), tables))
     assert got.tolist() == [True, True, False, False, False, True, True, True, False]
 
 
 def test_candidates_cjk_no_boundaries():
     tables = BadwordTables.build(["悪い"], check_boundaries=False)
     texts = ["これは悪い言葉です", "これは良い言葉です"]
-    got = np.asarray(badwords_candidates(*_pack(texts), tables))
+    got = np.asarray(badwords_matches(*_pack(texts), tables))
     assert got.tolist() == [True, False]
 
 
-def test_candidates_superset_of_regex_matches():
-    # Randomized: every regex match must be flagged (no false negatives).
+def test_matches_equal_regex_matches():
+    # Randomized: the kernel verdict must EQUAL the regex verdict (the host
+    # trusts it — no re-verification).
     import re
 
     words = ["alpha", "beta gamma", "zz"]
@@ -63,15 +64,15 @@ def test_candidates_superset_of_regex_matches():
         r"(?i)(?:\W|^)(" + "|".join(re.escape(w) for w in words) + r")(?:\W|$)"
     )
     rng = np.random.default_rng(5)
-    vocab = ["alpha", "beta", "gamma", "zz", "the", "dog,", "x", "beta gamma!"]
+    vocab = ["alpha", "beta", "gamma", "zz", "the", "dog,", "x", "beta gamma!",
+             "alphabet", "za", "z"]
     texts = [
         " ".join(vocab[j] for j in rng.integers(0, len(vocab), size=8))
-        for _ in range(64)
+        for _ in range(128)
     ]
-    got = np.asarray(badwords_candidates(*_pack(texts), tables))
+    got = np.asarray(badwords_matches(*_pack(texts), tables))
     for t, flag in zip(texts, got):
-        if pattern.search(t):
-            assert flag, f"regex matches but kernel missed: {t!r}"
+        assert bool(flag) == bool(pattern.search(t)), t
 
 
 def test_build_rejects_empty_or_oversized():
@@ -131,10 +132,11 @@ def test_device_parity_with_host_filter():
         ), k
 
 
-def test_device_lang_mismatch_falls_back_to_host_step():
+def test_other_vendored_language_decided_on_device(monkeypatch):
     config = parse_pipeline_config(CONFIG)
-    # metadata language 'da' != compiled 'en' -> per-doc host filter run,
-    # which applies the Danish list.
+    # metadata language 'da' != default 'en', but the Danish list is local,
+    # so its table is compiled too and the da docs are decided ON DEVICE —
+    # the host regex filter must never run (VERDICT r3 weak #7).
     danish_words = load_local_badwords("da")
     assert danish_words
     bad_da = danish_words[0]
@@ -142,17 +144,85 @@ def test_device_lang_mismatch_falls_back_to_host_step():
         _mk(0, f"dette indeholder {bad_da} desvaerre", {"language": "da"}),
         _mk(1, "helt ren tekst om vejret", {"language": "da"}),
     ]
-    import os
+    from textblaster_tpu.filters.c4_badwords import C4BadWordsFilter
 
-    cwd = os.getcwd()
-    os.chdir("/root/repo")  # vendored fallback path for the host filter
-    try:
-        dev = list(process_documents_device(config, iter(docs)))
-    finally:
-        os.chdir(cwd)
+    def _boom(self, document):
+        raise AssertionError("host regex filter ran for a compiled language")
+
+    monkeypatch.setattr(C4BadWordsFilter, "process", _boom)
+    dev = list(process_documents_device(config, iter(docs)))
     kinds = {o.document.id: o.kind for o in dev}
     assert kinds["d0"] == ProcessingOutcome.FILTERED
     assert kinds["d1"] == ProcessingOutcome.SUCCESS
+    statuses = {
+        o.document.id: o.document.metadata.get("c4_badwords_filter_status")
+        for o in dev
+    }
+    assert statuses == {"d0": "filtered", "d1": "passed"}
+
+
+def test_uncompiled_language_keeps_host_semantics():
+    yaml_cfg = """
+pipeline:
+  - type: C4BadWordsFilter
+    default_language: en
+    keep_fraction: 0.0
+    fail_on_missing_language: false
+"""
+    config = parse_pipeline_config(yaml_cfg)
+    # 'xx' has no list at all -> the host path's passed_no_regex semantics.
+    docs = [_mk(0, "whatever text", {"language": "xx"})]
+    dev = list(process_documents_device(config, iter(docs)))
+    assert dev[0].kind == ProcessingOutcome.SUCCESS
+    assert (
+        dev[0].document.metadata["c4_badwords_filter_status"] == "passed_no_regex"
+    )
+
+
+def test_cjk_fixture_decided_on_device(tmp_path, monkeypatch):
+    # Vendored-style CJK fixture: unanchored matching (c4_filters.rs:431-439)
+    # — the pattern hits even inside a longer run of characters.
+    (tmp_path / "zh").write_text("坏话\n脏字\n", encoding="utf-8")
+    yaml_cfg = """
+pipeline:
+  - type: C4BadWordsFilter
+    default_language: zh
+    keep_fraction: 0.0
+    fail_on_missing_language: true
+"""
+    config = parse_pipeline_config(yaml_cfg)
+    # cache_base_path is serde-skipped in YAML (reference parity); deployments
+    # set it programmatically or pre-seed the default cache dir.
+    config.pipeline[0].params.cache_base_path = tmp_path
+    texts = [
+        "这是一段坏话文字",      # match, embedded (no boundaries needed)
+        "这是一段好话文字",      # clean
+        "前缀脏字后缀连在一起",  # second pattern, embedded
+    ]
+    docs_h = [_mk(i, t, {"language": "zh"}) for i, t in enumerate(texts)]
+    docs_d = [_mk(i, t, {"language": "zh"}) for i, t in enumerate(texts)]
+
+    executor = build_pipeline_from_config(config)
+    host = {o.document.id: o for o in process_documents_host(executor, iter(docs_h))}
+
+    from textblaster_tpu.filters.c4_badwords import C4BadWordsFilter
+
+    def _boom(self, document):
+        raise AssertionError("host regex filter ran for a compiled language")
+
+    monkeypatch.setattr(C4BadWordsFilter, "process", _boom)
+    dev = {
+        o.document.id: o
+        for o in process_documents_device(config, iter(docs_d))
+    }
+    assert set(host) == set(dev)
+    for k in host:
+        assert host[k].kind == dev[k].kind, k
+        assert host[k].reason == dev[k].reason, k
+        assert (
+            host[k].document.metadata.get("c4_badwords_filter_status")
+            == dev[k].document.metadata.get("c4_badwords_filter_status")
+        ), k
 
 
 def test_keep_fraction_agrees_across_backends_and_order():

@@ -185,6 +185,41 @@ pipeline:
     assert_outcomes_equal(host_by_id, dev_by_id)
 
 
+def test_single_step_parity_c4_sentence_mode():
+    # split_paragraph: false — units are sentences (c4_filters.rs:150-156),
+    # separators synthesized on device from inter-sentence whitespace.
+    yaml_str = """
+pipeline:
+  - type: C4QualityFilter
+    split_paragraph: false
+    remove_citations: true
+    filter_no_terminal_punct: true
+    min_num_sentences: 2
+    min_words_per_line: 3
+    max_word_length: 50
+    filter_lorem_ipsum: true
+    filter_javascript: true
+    filter_curly_bracket: true
+    filter_policy: true
+"""
+    sentence_cases = [
+        "Første sætning er her. Anden sætning følger efter! Og en tredje?",
+        "En sætning med citat [1]. Endnu en med flere [2, 3] i midten.",
+        "Multi\nline text. With sentences spanning\nnewlines here. Ja tak.",
+        'Han sagde "Hej." Hun svarede "Farvel." De gik hver til sit.',
+        "No terminal punctuation at all just words flowing along",
+        "Kort. Kort igen. K. Og så en rigtig lang sætning til sidst her.",
+        "Ends with ellipsis... Then another sentence. And more text here.",
+        "  \n  Leading whitespace. Trailing too.  \n ",
+        # Zero-gap boundary (terminator directly followed by the next
+        # sentence) — device flags the row and host-fallbacks, still parity.
+        "First sentence.Second sentence follows. Og en tredje sætning her.",
+        "Dr. Hansen kom kl. 10. Mødet varede en time. Alle var glade.",
+    ]
+    host_by_id, dev_by_id = run_both(yaml_str, CORPUS + sentence_cases)
+    assert_outcomes_equal(host_by_id, dev_by_id)
+
+
 def test_single_step_parity_fineweb():
     yaml_str = """
 pipeline:

@@ -114,6 +114,57 @@ def test_pallas_sort_active_under_mesh(monkeypatch):
     np.testing.assert_array_equal(np.asarray(s_key), np.asarray(ref_k))
 
 
+def test_mesh_phased_short_circuit(monkeypatch):
+    """Phased short-circuit stays ON under a (single-controller) mesh
+    (VERDICT r3 weak #5): later phases dispatch shrinking survivor batches,
+    and outcomes remain bit-identical to the host oracle."""
+    config = parse_pipeline_config(YAML)
+    mesh = data_mesh()
+    pipeline = CompiledPipeline(config, buckets=(512,), batch_size=16, mesh=mesh)
+    assert len(pipeline.phases) > 1
+
+    calls = []
+    orig = pipeline.dispatch_batch
+
+    def spy(batch, phase=0):
+        calls.append((phase, len(batch.docs)))
+        return orig(batch, phase)
+
+    monkeypatch.setattr(pipeline, "dispatch_batch", spy)
+
+    # 8 Danish/English keepers + 24 gibberish docs the language phase kills.
+    texts = TEXTS[:2] * 4 + ["zzq qqz xjq wvx pqz kzx jqx vxq zzk qpx"] * 24
+    docs = [
+        TextDocument(id=f"p{i}", source="s", content=t)
+        for i, t in enumerate(texts)
+    ]
+    dev = list(process_documents_device(config, iter(docs), pipeline=pipeline))
+    per_phase = {}
+    for phase, n in calls:
+        per_phase[phase] = per_phase.get(phase, 0) + n
+    assert per_phase[0] == len(texts)
+    assert 0 < per_phase.get(1, 0) < len(texts)  # survivors only
+
+    host = list(
+        process_documents_host(
+            build_pipeline_from_config(config),
+            iter(
+                [
+                    TextDocument(id=f"p{i}", source="s", content=t)
+                    for i, t in enumerate(texts)
+                ]
+            ),
+        )
+    )
+    dev_by_id = {o.document.id: o for o in dev}
+    host_by_id = {o.document.id: o for o in host}
+    assert set(dev_by_id) == set(host_by_id)
+    for k in host_by_id:
+        assert dev_by_id[k].kind == host_by_id[k].kind, k
+        assert dev_by_id[k].reason == host_by_id[k].reason, k
+        assert dev_by_id[k].document.metadata == host_by_id[k].document.metadata, k
+
+
 def test_graft_entry_contract():
     import importlib.util
     import os

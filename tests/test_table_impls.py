@@ -90,6 +90,11 @@ def _k_c4(cps, lengths):
     c4s, c4c, c4l = S.c4_stage(cps, lengths, C4P, ML)
     out = dict(c4s)
     out["cps"], out["len"] = c4c, c4l
+    sp, sc, sl = S.c4_stage(
+        cps, lengths, C4P._replace(split_paragraph=False), ML
+    )
+    out.update({f"sent:{k}": v for k, v in sp.items()})
+    out["sent:cps"], out["sent:len"] = sc, sl
     return out
 
 

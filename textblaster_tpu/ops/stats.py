@@ -101,16 +101,19 @@ def hash_string(s: str) -> int:
 
 
 def _poly_hash_many(
-    values: Tuple[jax.Array, ...], in_seg: jax.Array, seg_start: jax.Array
+    values: Tuple[jax.Array, ...],
+    in_seg: jax.Array,
+    seg_start: jax.Array,
+    mul: int = 31,
 ) -> Tuple[jax.Array, ...]:
-    """Segmented polynomial hashes h = h*31 + v via ONE affine scan shared by
-    all ``values`` streams (they share the multiplier pattern, so fusing them
-    shares the carry-multiply work and the scan's memory passes).
+    """Segmented polynomial hashes h = h*mul + v via ONE affine scan shared
+    by all ``values`` streams (they share the multiplier pattern, so fusing
+    them shares the carry-multiply work and the scan's memory passes).
 
     Positions outside segments are pass-through; ``seg_start`` restarts.
     The value at each position is the hash of its segment's prefix.
     """
-    m = jnp.where(seg_start, 0, jnp.where(in_seg, 31, 1)).astype(jnp.int32)
+    m = jnp.where(seg_start, 0, jnp.where(in_seg, mul, 1)).astype(jnp.int32)
     accs = tuple(jnp.where(in_seg, v, 0).astype(jnp.int32) for v in values)
 
     def compose(x, y):
@@ -130,8 +133,10 @@ def _poly_hash_many(
     return out[1:]
 
 
-def _poly_hash(cps: jax.Array, in_seg: jax.Array, seg_start: jax.Array) -> jax.Array:
-    return _poly_hash_many((cps,), in_seg, seg_start)[0]
+def _poly_hash(
+    cps: jax.Array, in_seg: jax.Array, seg_start: jax.Array, mul: int = 31
+) -> jax.Array:
+    return _poly_hash_many((cps,), in_seg, seg_start, mul=mul)[0]
 
 
 def _scatter(values, idx, active, m, fill=0, op="set"):
@@ -946,14 +951,10 @@ _SENT_T[2, :] = [0, 2, 2, 0]
 _SENT_T[3, :] = [0, 3, 3, 3]
 
 
-def sentence_counts(cps: jax.Array, lengths: jax.Array) -> jax.Array:
-    """Sentences per row — ``len(split_into_sentences(text))`` for rows whose
-    content is already globally trimmed (C4's rewritten batches are)."""
-    _, length = cps.shape
-    mask = jnp.arange(length, dtype=jnp.int32)[None, :] < lengths[:, None]
-    cls = classify(cps)
-    cls = jnp.where(mask, cls, 0).astype(cls.dtype)
-
+def sentence_boundaries(cps: jax.Array, mask: jax.Array, cls: jax.Array) -> jax.Array:
+    """[B, L] bool — a sentence boundary falls immediately BEFORE each True
+    position (the device twin of utils.text._sentence_boundaries, applied to
+    the chars selected by ``mask``)."""
     term = isin_sorted(cps, jnp.asarray(_TERM_SET)) & mask
     sterm = isin_sorted(cps, jnp.asarray(_STERM_SET)) & mask
     close = isin_sorted(cps, jnp.asarray(_CLOSE_SET)) & mask
@@ -986,7 +987,17 @@ def sentence_counts(cps: jax.Array, lengths: jax.Array) -> jax.Array:
     candidate = mask & (prev_state > 0) & ((state == 0) | fresh_term)
 
     no_break = ~prev_has_sterm & ((dot_last & alnum_) | lower)
-    boundary = (candidate & ~no_break) | (_shift_r(psep, False) & mask)
+    return (candidate & ~no_break) | (_shift_r(psep, False) & mask)
+
+
+def sentence_counts(cps: jax.Array, lengths: jax.Array) -> jax.Array:
+    """Sentences per row — ``len(split_into_sentences(text))`` for rows whose
+    content is already globally trimmed (C4's rewritten batches are)."""
+    _, length = cps.shape
+    mask = jnp.arange(length, dtype=jnp.int32)[None, :] < lengths[:, None]
+    cls = classify(cps)
+    cls = jnp.where(mask, cls, 0).astype(cls.dtype)
+    boundary = sentence_boundaries(cps, mask, cls)
 
     # Count segments containing >= 1 non-ws char.
     ws = (cls & WS) != 0
@@ -1039,8 +1050,14 @@ def c4_stage(
     Returns ``(stats, new_cps, new_lengths)``: the new batch is the rewritten
     content (kept lines joined by ``\\n``) for every row.
 
-    Only ``split_paragraph=True`` (the shipped config's mode) runs on device;
-    sentence-split mode goes through the host fallback.
+    ``split_paragraph=True`` segments on newlines (``content.lines()``,
+    c4_filters.rs:150-156); ``False`` segments on sentence boundaries via the
+    shared sentence DFA (:func:`sentence_boundaries`), synthesizing one
+    ``\\n`` separator per kept-sentence join from the inter-sentence
+    whitespace.  A sentence boundary with NO whitespace after it (rare:
+    terminator directly followed by the next sentence's first char) cannot
+    host a separator — those rows set ``line_overflow`` and take the counted
+    bit-exact host fallback.
     """
     _, length = cps.shape
     mask = jnp.arange(length, dtype=jnp.int32)[None, :] < lengths[:, None]
@@ -1048,6 +1065,7 @@ def c4_stage(
     cls = jnp.where(mask, cls, 0).astype(cls.dtype)
     ws = (cls & WS) != 0
     low = _lowered(cps, mask)
+    pos = jnp.arange(length, dtype=jnp.int32)[None, :]
 
     # Doc-level early rejects (c4_filters.rs:166-187).
     if params.filter_lorem_ipsum:
@@ -1056,34 +1074,80 @@ def c4_stage(
         has_lorem = jnp.zeros(cps.shape[0], dtype=bool)
     has_curly = jnp.any(((cps == ord("{")) | (cps == ord("}"))) & mask, axis=1)
 
-    li = line_info(cps, mask)
-    nonws = li.content & ~ws
-    reset = _line_reset(li, mask)
-
-    # Per-line trim: chars at/after the first non-ws and at/before the last.
-    after_first = seg_scan_add(nonws.astype(jnp.int32), reset) >= 1
-    r_reset = _first_col(mask) | _shift_r(rev(li.is_nl), False)
-    before_last = rev(seg_scan_add(rev(nonws).astype(jnp.int32), r_reset) >= 1)
-    in_line_trim = li.content & after_first & before_last
-
-    if params.remove_citations:
+    def _citation_deleted(unit_content):
+        if not params.remove_citations:
+            return jnp.zeros_like(mask)
         # Citation machinery only runs on batches that contain a '[' at all
         # (rare in clean text — the same skip the oracle's regex scan gets
         # from its first-byte check).
-        deleted = jax.lax.cond(
+        return jax.lax.cond(
             jnp.any((cps == ord("[")) & mask),
             lambda: citation_spans(
-                jnp.where(li.content, cps, 0),
-                ((cls & DIGIT) != 0) & li.content,
-                ws & li.content,
+                jnp.where(unit_content, cps, 0),
+                ((cls & DIGIT) != 0) & unit_content,
+                ws & unit_content,
             ),
             lambda: jnp.zeros_like(mask),
         )
-    else:
-        deleted = jnp.zeros_like(mask)
 
-    keep1 = (in_line_trim & ~deleted) | li.is_nl
-    c1_cps, c1_len = compact(cps, keep1, mesh=mesh)
+    gap_overflow = jnp.zeros(cps.shape[0], dtype=bool)
+    if params.split_paragraph:
+        li = line_info(cps, mask)
+        nonws = li.content & ~ws
+        reset = _line_reset(li, mask)
+
+        # Per-line trim: chars at/after the first non-ws, at/before the last.
+        after_first = seg_scan_add(nonws.astype(jnp.int32), reset) >= 1
+        r_reset = _first_col(mask) | _shift_r(rev(li.is_nl), False)
+        before_last = rev(seg_scan_add(rev(nonws).astype(jnp.int32), r_reset) >= 1)
+        in_line_trim = li.content & after_first & before_last
+
+        deleted = _citation_deleted(li.content)
+        keep1 = (in_line_trim & ~deleted) | li.is_nl
+        c1_src = cps
+        n_units = li.n_lines
+    else:
+        # Sentence mode: global trim (split_into_sentences trims the input,
+        # utils/text.py), boundaries from the shared DFA, segments between
+        # boundaries, each trimmed; blank segments are not sentences.
+        nonws_all = mask & ~ws
+        any_nonws = jnp.any(nonws_all, axis=1)
+        t0 = jnp.min(jnp.where(nonws_all, pos, length), axis=1)
+        t1 = jnp.max(jnp.where(nonws_all, pos, -1), axis=1)
+        in_trim = (pos >= t0[:, None]) & (pos <= t1[:, None]) & mask
+
+        boundary = sentence_boundaries(cps, in_trim, cls)
+        seg_begin = (boundary | (pos == t0[:, None])) & in_trim
+        nonws = in_trim & ~ws
+
+        cnt = seg_scan_add(nonws.astype(jnp.int32), seg_begin)
+        first_nonws_seg = nonws & (cnt == 1)
+        n_units = jnp.sum(first_nonws_seg, axis=1).astype(jnp.int32)
+
+        # Segment ends: last char of each segment (next char starts a new
+        # one or leaves the trim).
+        seg_end = in_trim & (_shift_l(seg_begin, False) | ~_shift_l(in_trim, False))
+        r_reset = _first_col(mask) | rev(seg_end)
+        cnt_r = seg_scan_add(rev(nonws).astype(jnp.int32), r_reset)
+        before_last = rev(cnt_r >= 1)
+        in_sent_trim = in_trim & (cnt >= 1) & before_last
+        sent_last_nonws = rev(rev(nonws) & (cnt_r == 1))
+
+        # One synthesized '\n' per kept-sentence join: the first char after
+        # each sentence's trimmed end (inter-sentence gaps are pure ws), if
+        # any sentence follows.
+        suffix_nonws = _shift_l(
+            rev(jnp.cumsum(rev(nonws).astype(jnp.int32), axis=1)) > 0, False
+        )
+        sep_keep = _shift_r(sent_last_nonws, False) & ~nonws & in_trim & suffix_nonws
+        gap_overflow = jnp.any(sent_last_nonws & _shift_l(nonws, False), axis=1)
+
+        deleted = _citation_deleted(in_trim)
+        keep1 = (in_sent_trim & ~deleted) | sep_keep
+        c1_src = jnp.where(sep_keep, jnp.int32(NL), cps)
+        del any_nonws  # rows without content have empty keep1 already
+
+    c1_cps, c1_len = compact(c1_src, keep1, mesh=mesh)
 
     # --- per-line checks on the compacted batch ---
     m1 = jnp.arange(length, dtype=jnp.int32)[None, :] < c1_len[:, None]
@@ -1179,11 +1243,12 @@ def c4_stage(
     )
     ends_ellipsis = line_end_dots >= 3
 
-    # Line count comes from the ORIGINAL batch: a final line whose content
+    # Unit count comes from the ORIGINAL batch: a final line whose content
     # trimmed away entirely has no chars and no trailing \n in the compacted
     # batch, so li1 under-counts it — but it still exists as a (droppable)
-    # line in the oracle's rust_lines view.
-    n_lines1 = li.n_lines
+    # line in the oracle's rust_lines view.  (Sentence mode has no such
+    # invisible units: every sentence contains a non-ws char.)
+    n_lines1 = n_units
     line_exists = jnp.arange(max_lines, dtype=jnp.int32)[None, :] < n_lines1[:, None]
 
     if params.max_word_length > 0:
@@ -1227,6 +1292,6 @@ def c4_stage(
         "drop_too_long": jnp.sum(drop_too_long, axis=1).astype(jnp.int32),
         "drop_no_term": jnp.sum(drop_no_term, axis=1).astype(jnp.int32),
         "drop_few_words": jnp.sum(drop_few_words, axis=1).astype(jnp.int32),
-        "line_overflow": n_lines1 > max_lines,
+        "line_overflow": (n_lines1 > max_lines) | gap_overflow,
     }
     return stats, c2_cps, c2_len
