@@ -36,8 +36,18 @@ import time
 import numpy as np
 
 N_DOCS = 4096
-CPU_SAMPLE = 384  # oracle subsample, extrapolated
+# Oracle runs the FULL corpus (no subsample extrapolation): at measured
+# oracle rates (600-7000 docs/s) a 4096-doc pass costs single-digit seconds,
+# and decision parity is then checked on every document.  BENCH_CPU_SAMPLE
+# overrides for quick experiments.
+CPU_SAMPLE = int(os.environ.get("BENCH_CPU_SAMPLE", str(N_DOCS)))
 SEED = 20260729
+
+# Long-doc config: fewer, much longer documents exercising the 8k-32k
+# buckets that dominate compile time and were previously unmeasured
+# (VERDICT r3 weak #9).
+LONGDOC_N_DOCS = 512
+LONGDOC_BUCKETS = (8192, 32768)
 
 # Device batch rows.  Large batches amortize the remote tunnel's per-dispatch
 # round trip (~66ms) and upload latency (~65 MB/s measured); 1024 rows of the
@@ -79,9 +89,11 @@ _DEFAULT_BUCKETS = (512, 1024, 1536, 2048)
 _TPU_BUCKETS = (512, 1024, 2048)
 
 
-def buckets_for_platform(platform: str):
+def buckets_for_platform(platform: str, bench_name: str = "full"):
     if os.environ.get("BENCH_BUCKETS"):
         return _buckets()
+    if bench_name == "longdoc":
+        return LONGDOC_BUCKETS
     return _DEFAULT_BUCKETS if platform == "cpu" else _TPU_BUCKETS
 
 
@@ -180,6 +192,35 @@ _ENGLISH_WORDS = (
 ).split()
 
 
+def _make_longdocs(rng: np.random.Generator):
+    """Long documents (~4k-30k chars): web-dump pages, transcripts, listy
+    boilerplate — the raggedness axis SURVEY.md §5 calls out."""
+    from textblaster_tpu.data_model import TextDocument
+
+    docs = []
+    for i in range(LONGDOC_N_DOCS):
+        kind = rng.random()
+        words = _DANISH_WORDS if kind < 0.7 else _ENGLISH_WORDS
+        n_sentences = int(rng.integers(60, 420))
+        lines = []
+        for _ in range(n_sentences):
+            n_w = int(rng.integers(4, 18))
+            ws = [words[int(rng.integers(0, len(words)))] for _ in range(n_w)]
+            lines.append(" ".join(ws).capitalize() + ".")
+        parts = []
+        j = 0
+        while j < len(lines):
+            k = int(rng.integers(1, 6))
+            parts.append(" ".join(lines[j : j + k]))
+            j += k
+        content = "\n".join(parts)
+        if kind > 0.95:
+            # Dense repetition at length: the dup-table worst case.
+            content = ("Samme lange linje her igen og igen.\n" * 200)[:8000]
+        docs.append(TextDocument(id=f"ldoc-{i}", source="bench", content=content))
+    return docs
+
+
 def _make_docs(rng: np.random.Generator):
     from textblaster_tpu.data_model import TextDocument
 
@@ -274,8 +315,9 @@ def _load_config(name: str):
 
     if name in _BENCH_CONFIGS:
         return parse_pipeline_config(_BENCH_CONFIGS[name])
-    # "full": the shipped Danish pipeline minus TokenCounter (host-side BPE
-    # step; the bench measures the device-covered filter pipeline).
+    # "full" / "longdoc": the shipped Danish pipeline minus TokenCounter
+    # (host-side BPE step; the bench measures the device-covered filter
+    # pipeline).
     with open("configs/pipeline_config.yaml", encoding="utf-8") as f:
         raw = _yaml.safe_load(f)
     raw["pipeline"] = [s for s in raw["pipeline"] if s["type"] != "TokenCounter"]
@@ -316,8 +358,9 @@ def main() -> int:
     config = _load_config(bench_name)
 
     rng = np.random.default_rng(SEED)
-    docs = _make_docs(rng)
-    _log(f"generated {len(docs)} docs")
+    docs = _make_longdocs(rng) if bench_name == "longdoc" else _make_docs(rng)
+    cpu_sample = min(CPU_SAMPLE, len(docs))
+    _log(f"generated {len(docs)} docs (max {max(len(d.content) for d in docs)} chars)")
 
     # --- CPU oracle baseline (single process; the reference-equivalent path).
     # Best-of-2 for both sides: this box has ONE core and a background TPU
@@ -327,7 +370,7 @@ def main() -> int:
     executor = build_pipeline_from_config(config)
     cpu_elapsed = float("inf")
     for _ in range(2):
-        sample = [d.copy() for d in docs[:CPU_SAMPLE]]
+        sample = [d.copy() for d in docs[:cpu_sample]]
         t0 = time.perf_counter()
         host_outcomes = list(process_documents_host(executor, iter(sample)))
         cpu_elapsed = min(cpu_elapsed, time.perf_counter() - t0)
@@ -339,14 +382,22 @@ def main() -> int:
     # never bills a compile or an executable (re)load to the measurement.
     _log(f"device backend: {jax.default_backend()}")
     device_batch = _device_batch()
+    if bench_name == "longdoc" and not os.environ.get("BENCH_BATCH"):
+        device_batch = 64  # 64 rows x 32k chars = 8 MB/dispatch, same as full
     pipeline = CompiledPipeline(
-        config, buckets=buckets_for_platform(platform), batch_size=device_batch
+        config,
+        buckets=buckets_for_platform(platform, bench_name),
+        batch_size=device_batch,
     )
-    # Full-corpus warmup pass: every (bucket, phase) program the timed run
-    # will dispatch gets compiled here (a small warm slice would leave some
-    # shapes cold and bill their compiles to the timed run).
-    warm = [d.copy() for d in docs]
+    # Concurrent AOT compile of every (bucket, phase) program, then a
+    # full-corpus warm pass (a small warm slice would leave some shapes cold
+    # and bill their compiles to the timed run).  On the remote tunnel the
+    # parallel compiles cost ~the slowest program instead of the sum — the
+    # round-3 cold warmup was 459s of serial tunnel compiles.
     t0 = time.perf_counter()
+    compile_s = pipeline.warmup_parallel()
+    _log(f"parallel AOT compile done in {compile_s:.1f}s")
+    warm = [d.copy() for d in docs]
     list(process_documents_device(config, iter(warm), pipeline=pipeline))
     warmup_s = time.perf_counter() - t0
     _log(f"device warmup (compile+first pass) done in {warmup_s:.1f}s")
@@ -365,10 +416,39 @@ def main() -> int:
         dev_elapsed = min(dev_elapsed, time.perf_counter() - t0)
     dev_rate = len(run_docs) / dev_elapsed
     _log(f"device: {dev_rate:.1f} docs/s over {len(run_docs)} docs (best of 2)")
+    # Read the honesty counters HERE: they must cover exactly the 2 timed
+    # passes, not the parity pass below (which also re-runs fallbacks).
+    fallback_frac = round(
+        (METRICS.get("worker_host_fallback_total") - fallbacks_before)
+        / max(2 * len(run_docs), 1),
+        4,
+    )
+    tail_frac = round(
+        (METRICS.get("worker_host_tail_total") - tails_before)
+        / max(2 * len(run_docs), 1),
+        4,
+    )
 
-    # --- Decision parity check on the CPU subsample.
+    # --- Decision parity: a dedicated device pass with host-tail routing OFF
+    # (TEXTBLAST_HOST_TAILS=off, as the parity test suites run), so every row
+    # in the parity denominator was decided by device kernels, not the
+    # bit-exact host tail path (ADVICE r3 item 3).  Compared against the
+    # full-corpus oracle outcomes.
     host_by_id = {o.document.id: o.kind for o in host_outcomes}
-    dev_by_id = {o.document.id: o.kind for o in dev_outcomes}
+    prev_tails = os.environ.get("TEXTBLAST_HOST_TAILS")
+    os.environ["TEXTBLAST_HOST_TAILS"] = "off"
+    try:
+        parity_outcomes = list(
+            process_documents_device(
+                config, iter([d.copy() for d in docs]), pipeline=pipeline
+            )
+        )
+    finally:
+        if prev_tails is None:
+            os.environ.pop("TEXTBLAST_HOST_TAILS", None)
+        else:
+            os.environ["TEXTBLAST_HOST_TAILS"] = prev_tails
+    dev_by_id = {o.document.id: o.kind for o in parity_outcomes}
     agree = sum(
         1 for k, v in host_by_id.items() if dev_by_id.get(k) == v
     )
@@ -380,26 +460,29 @@ def main() -> int:
         "unit": "docs/s",
         "vs_baseline": round(dev_rate / cpu_rate, 3),
         "cpu_baseline_docs_per_sec": round(cpu_rate, 2),
+        # The BASELINE.json north star divides by a 32-worker CPU fleet.  The
+        # reference's workers are embarrassingly parallel (one queue, no
+        # shared state), so the fleet rate is modeled as 32x the single-core
+        # oracle measured here — this box has one core, a real fleet can't
+        # be run on it.
+        "cpu_baseline_workers": 1,
+        "north_star_docs_per_sec": round(32 * cpu_rate, 2),
+        "vs_32_worker_fleet": round(dev_rate / (32 * cpu_rate), 4),
         "decision_parity": round(parity, 6),
+        "parity_denominator": len(host_by_id),
         "n_docs": len(run_docs),
         "platform": jax.default_backend(),
         "warmup_s": round(warmup_s, 1),
+        "warmup_compile_s": round(compile_s, 1),
         # Docs the device path re-ran on the host oracle (outliers / table
-        # overflow).  A high rate means the headline number is partly the
-        # Python path — it must stay near zero for the record to be honest.
-        "host_fallback_frac": round(
-            (METRICS.get("worker_host_fallback_total") - fallbacks_before)
-            / max(2 * len(run_docs), 1),  # 2 timed passes (best-of-2)
-            4,
-        ),
+        # overflow) during the 2 timed passes.  A high rate means the
+        # headline number is partly the Python path — it must stay near zero
+        # for the record to be honest.
+        "host_fallback_frac": fallback_frac,
         # Docs deliberately routed to the host oracle as end-of-stream tail
         # groups (scheduling choice, distinct from fallbacks; the host path
         # is bit-exact, so parity is unaffected — only throughput attribution).
-        "host_tail_frac": round(
-            (METRICS.get("worker_host_tail_total") - tails_before)
-            / max(2 * len(run_docs), 1),  # 2 timed passes (best-of-2)
-            4,
-        ),
+        "host_tail_frac": tail_frac,
     }
     if probe_failures:
         result["probe_failures"] = probe_failures

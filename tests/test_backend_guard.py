@@ -1,0 +1,66 @@
+"""backend_guard must actually patch JAX's backend factories (ADVICE r3).
+
+``force_cpu_backend`` rewrites private JAX internals; on API drift it
+degrades to env-var-only protection with a log warning — which would quietly
+reintroduce the remote-plugin first-init hang it exists to prevent.  These
+tests make that drift fail CI instead:
+
+* in a fresh subprocess (backends uninitialized), the patch must take: every
+  non-cpu factory raises instead of dialing out, and jax still computes on
+  cpu afterwards;
+* the ``_registration_like`` helper must keep working against the pinned
+  JAX version's registration type.
+"""
+
+import subprocess
+import sys
+
+
+def test_factory_patch_takes_effect_before_first_init():
+    code = r"""
+import os
+os.environ.pop("JAX_PLATFORMS", None)  # guard must not rely on the env var
+from textblaster_tpu.utils.backend_guard import force_cpu_backend
+force_cpu_backend()
+
+from jax._src import xla_bridge as xb
+assert not xb.backends_are_initialized()
+non_cpu = [n for n in xb._backend_factories if n != "cpu"]
+for name in non_cpu:
+    reg = xb._backend_factories[name]
+    try:
+        reg.factory()
+        raise SystemExit(f"factory {name!r} did not refuse")
+    except RuntimeError as e:
+        assert "disabled by force_cpu_backend" in str(e), (name, e)
+    assert reg.fail_quietly, name
+
+import jax, jax.numpy as jnp
+assert jax.default_backend() == "cpu"
+assert float(jnp.ones((8, 8)).sum()) == 64.0
+print("PATCH_OK", len(non_cpu))
+"""
+    res = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=240,
+        cwd="/root/repo",
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "PATCH_OK" in res.stdout
+
+
+def test_registration_like_matches_pinned_jax():
+    from jax._src import xla_bridge as xb
+
+    from textblaster_tpu.utils.backend_guard import _registration_like
+
+    reg = xb._backend_factories["cpu"]
+
+    def _f():  # pragma: no cover - never called
+        raise RuntimeError("x")
+
+    clone = _registration_like(reg, factory=_f)
+    assert clone.factory is _f
+    assert clone.fail_quietly

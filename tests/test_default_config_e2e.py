@@ -1,0 +1,58 @@
+"""The SHIPPED config must execute in-image, TokenCounter included
+(VERDICT r3 item 8): `textblast run -c configs/pipeline_config.yaml`
+unmodified, Parquet in -> kept/excluded Parquet out, with
+``metadata["token_count"]`` stamped by the vendored-stand-in BPE when the
+hub is unreachable (filters/token_counter.py resolution step 4).
+"""
+
+import json
+from pathlib import Path
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from textblaster_tpu.cli import main
+
+DANISH_KEEPER = (
+    "Det er en rigtig god dag i dag, og vi skal ud at gå en lang tur i den "
+    "store grønne skov. Solen skinner over hele byen, og der er mange glade "
+    "mennesker på gaden netop nu. Efter turen vil vi gerne drikke en stor kop "
+    "varm kaffe og spise lidt friskbagt brød hjemme i køkkenet. Det bliver en "
+    "rigtig dejlig eftermiddag, fordi vejret er så fint og mildt i dag. Om "
+    "aftenen skal vi lave god mad sammen og se en lang film inde i stuen. "
+    "Bagefter taler vi om planerne for den næste uge, og så går vi i seng."
+)
+
+
+def test_shipped_config_runs_with_token_counter(tmp_path: Path):
+    inp = tmp_path / "in.parquet"
+    pq.write_table(
+        pa.table(
+            {
+                "id": ["keep-1", "drop-1"],
+                "text": [DANISH_KEEPER, "kort."],
+            }
+        ),
+        inp,
+    )
+    out = tmp_path / "out.parquet"
+    exc = tmp_path / "exc.parquet"
+    rc = main(
+        [
+            "run",
+            "-i", str(inp),
+            "-c", "configs/pipeline_config.yaml",  # unmodified shipped config
+            "-o", str(out),
+            "-e", str(exc),
+            "--backend", "cpu",
+            "--quiet",
+        ]
+    )
+    assert rc == 0
+    kept = pq.read_table(out).to_pylist()
+    assert [r["id"] for r in kept] == ["keep-1"]
+    md = json.loads(kept[0]["metadata"])
+    assert int(md["token_count"]) > 50
+    assert md["c4_filter_status"] == "passed"
+    dropped = pq.read_table(exc).to_pylist()
+    assert [r["id"] for r in dropped] == ["drop-1"]

@@ -165,3 +165,49 @@ def test_fuzz_dense_repetition_walk_parity():
         texts.append(text[:2000])
     host_by_id, dev_by_id = run_both(GOPHER_REP_YAML, texts)
     assert_outcomes_equal(host_by_id, dev_by_id)
+
+
+C4_FIRST_YAML = """
+pipeline:
+  - type: C4QualityFilter
+    split_paragraph: true
+    remove_citations: true
+    filter_no_terminal_punct: true
+    min_num_sentences: 1
+    min_words_per_line: 2
+    max_word_length: 60
+    filter_lorem_ipsum: true
+    filter_javascript: true
+    filter_curly_bracket: true
+    filter_policy: true
+  - type: GopherQualityFilter
+    min_doc_words: 6
+    min_stop_words: 1
+    stop_words: [ "og", "the", "er", "i" ]
+  - type: FineWebQualityFilter
+    line_punct_thr: 0.1
+    line_punct_exclude_zero: false
+    short_line_thr: 0.95
+    short_line_length: 8
+    char_duplicates_ratio: 0.5
+    new_line_ratio: 0.5
+"""
+
+
+def test_fuzz_c4_before_gopher_with_trailing_step():
+    """ADVICE r3 item 1: a content-REWRITING step ordered before other device
+    steps with a trailing step.  The pipeline must refuse to phase-split
+    (later-phase host-fallback reruns would re-run the rewrite on rewritten
+    content) and stay bit-identical to the oracle."""
+    from textblaster_tpu.config.pipeline import parse_pipeline_config
+    from textblaster_tpu.ops.pipeline import CompiledPipeline
+
+    pipeline = CompiledPipeline(
+        parse_pipeline_config(C4_FIRST_YAML), buckets=(512,), batch_size=8
+    )
+    assert len(pipeline.phases) == 1  # rewrite not in final phase -> fused
+
+    rng = np.random.default_rng(SEED + 3)
+    texts = [_make_doc(rng) for _ in range(96)]
+    host_by_id, dev_by_id = run_both(C4_FIRST_YAML, texts)
+    assert_outcomes_equal(host_by_id, dev_by_id)

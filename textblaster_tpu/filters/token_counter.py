@@ -12,7 +12,11 @@ often egress-less):
 1. a local path to a ``tokenizer.json`` file or a directory containing one;
 2. a local ``merges.txt`` (GPT-2 byte-level BPE) counted by the native C++
    core (``textblaster_tpu/native``) — no vocab ids are needed for a count;
-3. the HuggingFace hub cache / network via ``tokenizers.Tokenizer.from_pretrained``.
+3. the HuggingFace hub cache / network via ``tokenizers.Tokenizer.from_pretrained``;
+4. a vendored stand-in under ``textblaster_tpu/data/tokenizers/<name>/`` —
+   an in-repo-trained byte-level BPE shipped so the default config's
+   ``TokenCounter(gpt2)`` executes on egress-less machines (see the README
+   beside it; hub/cache wins whenever reachable).
 
 A load failure raises ``UnexpectedError("Error in loading tokenizer")`` at
 construction, matching the reference's build-time failure surface
@@ -58,7 +62,28 @@ class TokenCounter(ProcessingStep):
             else:
                 from tokenizers import Tokenizer
 
-                self._tokenizer = Tokenizer.from_pretrained(tokenizer_name)
+                try:
+                    self._tokenizer = Tokenizer.from_pretrained(tokenizer_name)
+                except Exception:
+                    vendored = os.path.join(
+                        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                        "data",
+                        "tokenizers",
+                        tokenizer_name,
+                        "tokenizer.json",
+                    )
+                    if not os.path.isfile(vendored):
+                        raise
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "tokenizer %r unavailable from hub/cache; using the "
+                        "vendored stand-in at %s (counts differ from the hub "
+                        "tokenizer — see its README)",
+                        tokenizer_name,
+                        vendored,
+                    )
+                    self._tokenizer = Tokenizer.from_file(vendored)
         except Exception as e:
             raise UnexpectedError("Error in loading tokenizer") from e
 

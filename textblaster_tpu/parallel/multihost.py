@@ -129,6 +129,13 @@ def run_local_shard(
     fallback).
 
     Returns outcomes for **this host's** documents only.
+
+    Phased short-circuit, lockstep-safe (VERDICT r3 item 3): for EVERY phase
+    the per-bucket round counts are renegotiated over allgather from the
+    hosts' surviving document counts, so all processes dispatch the identical
+    program sequence while later phases run on shrinking, repacked survivor
+    batches — the device analogue of the executor short-circuit that the
+    single-controller path already had.
     """
     from ..ops.pipeline import CompiledPipeline
     from ..orchestration import execute_processing_pipeline
@@ -145,48 +152,67 @@ def run_local_shard(
         pipeline = CompiledPipeline(config, buckets=buckets, mesh=mesh)
     local_batch = pipeline.batch_size // n_proc
 
-    fits: dict = {b: [] for b in buckets}
-    fallback: List[TextDocument] = []
-    for d in docs:
-        for b in buckets:
-            if len(d.content) <= b - PACK_MARGIN:
-                fits[b].append(d)
-                break
-        else:
-            fallback.append(d)
+    def partition(ds: Sequence[TextDocument]):
+        by_bucket: dict = {b: [] for b in buckets}
+        over: List[TextDocument] = []
+        for d in ds:
+            for b in buckets:
+                if len(d.content) <= b - PACK_MARGIN:
+                    by_bucket[b].append(d)
+                    break
+            else:
+                over.append(d)
+        return by_bucket, over
 
-    needed_local = np.array(
-        [math.ceil(len(fits[b]) / local_batch) for b in buckets], dtype=np.int32
-    )
-    schedule = _negotiate_max(needed_local)
-    if rounds is not None and int(schedule.sum()) > rounds:
-        raise ValueError(
-            f"shard needs {int(schedule.sum())} rounds "
-            f"(local {int(needed_local.sum())}), got {rounds}"
-        )
+    current, fallback = partition(docs)
 
     sh2 = batch_sharding(mesh, 2)
     sh1 = batch_sharding(mesh, 1)
 
     outcomes: List[ProcessingOutcome] = []
-    pending = None  # (local_batch, device_out): one round in flight
-    for b, n_rounds in zip(buckets, schedule):
-        fn = pipeline._fn_for(b)
-        for r in range(int(n_rounds)):
-            chunk = fits[b][r * local_batch : (r + 1) * local_batch]
-            local = pack_documents(chunk, batch_size=local_batch, max_len=b)
-            g_cps = jax.make_array_from_process_local_data(sh2, local.cps)
-            g_len = jax.make_array_from_process_local_data(sh1, local.lengths)
-            out = fn(g_cps, g_len)
-            if pending is not None:
-                outcomes.extend(
-                    pipeline.assemble_batch(pending[0], _local_stats(pending[1]))
-                )
-            pending = (local, out)
-    if pending is not None:
-        outcomes.extend(
-            pipeline.assemble_batch(pending[0], _local_stats(pending[1]))
+    n_phases = len(pipeline.phases)
+    for phase in range(n_phases):
+        needed_local = np.array(
+            [math.ceil(len(current[b]) / local_batch) for b in buckets],
+            dtype=np.int32,
         )
+        schedule = _negotiate_max(needed_local)
+        if phase == 0 and rounds is not None and int(schedule.sum()) > rounds:
+            raise ValueError(
+                f"shard needs {int(schedule.sum())} rounds "
+                f"(local {int(needed_local.sum())}), got {rounds}"
+            )
+
+        survivors: List[TextDocument] = []
+        pending = None  # (local_batch, device_out): one round in flight
+        for b, n_rounds in zip(buckets, schedule):
+            fn = pipeline._fn_for(b, phase)
+            for r in range(int(n_rounds)):
+                chunk = current[b][r * local_batch : (r + 1) * local_batch]
+                local = pack_documents(chunk, batch_size=local_batch, max_len=b)
+                g_cps = jax.make_array_from_process_local_data(sh2, local.cps)
+                g_len = jax.make_array_from_process_local_data(sh1, local.lengths)
+                out = fn(g_cps, g_len)
+                if pending is not None:
+                    po, alive = pipeline.assemble_phase(
+                        pending[0], _local_stats(pending[1]), phase
+                    )
+                    outcomes.extend(po)
+                    survivors.extend(alive)
+                pending = (local, out)
+        if pending is not None:
+            po, alive = pipeline.assemble_phase(
+                pending[0], _local_stats(pending[1]), phase
+            )
+            outcomes.extend(po)
+            survivors.extend(alive)
+        if phase == n_phases - 1:
+            break
+        # Survivor content may have been rewritten (C4) — repack by the
+        # current length.  Growth past every bucket is impossible (rewrites
+        # only drop chars), but route defensively anyway.
+        current, over = partition(survivors)
+        fallback.extend(over)
 
     for d in fallback:
         METRICS.inc("worker_host_fallback_total")
