@@ -180,7 +180,8 @@ def _normalize_codepoints(text: str) -> List[int]:
     """Lowercase letters kept; every other char becomes the boundary marker.
 
     Runs of boundary markers collapse, and the sequence is wrapped in
-    boundaries, so word-edge trigrams are well-defined.
+    boundaries, so word-edge trigrams are well-defined.  Training-time form
+    (per-char Python); the hot scoring path uses the vectorized twin below.
     """
     out: List[int] = [0]
     for ch in text.lower():
@@ -191,6 +192,49 @@ def _normalize_codepoints(text: str) -> List[int]:
     if out[-1] != 0:
         out.append(0)
     return out
+
+
+def _norm_tables():
+    """(lower [MAX_CP] int32, alpha-of-lower [MAX_CP] bool) — the same
+    char/lower tables the device kernel gathers (ops/device.py), so the host
+    scorer and the device kernel normalize identically by construction
+    (including chars whose str.lower() is multi-char, which both treat as
+    identity — unlike whole-string ``text.lower()``)."""
+    global _NORM_TABLES
+    if _NORM_TABLES is None:
+        from ..ops.device import _class_table_np, _lower_table_np
+        from ..utils import chartables as ct
+
+        lower = _lower_table_np()
+        alpha = (_class_table_np()[lower] & ct.ALPHA) != 0
+        _NORM_TABLES = (lower, alpha)
+    return _NORM_TABLES
+
+
+_NORM_TABLES = None
+
+
+def _normalize_vec(text: str) -> "np.ndarray":
+    """Vectorized scoring-path twin of :func:`_normalize_codepoints`:
+    boundary-wrapped lowercased letters with non-letter runs collapsed,
+    as an int64 array."""
+    from ..utils.chartables import codepoints
+
+    lower, alpha = _norm_tables()
+    arr = codepoints(text).astype(np.int64)
+    clipped = np.minimum(arr, lower.shape[0] - 1)
+    # Out-of-table codepoints are non-letters; `low` is only read at letter
+    # positions, so the clipped gather is enough.
+    low = lower[clipped]
+    is_letter = np.zeros(arr.shape[0] + 2, dtype=bool)
+    is_letter[1:-1] = alpha[clipped] & (arr < lower.shape[0])
+    vals = np.zeros(arr.shape[0] + 2, dtype=np.int64)
+    vals[1:-1] = np.where(is_letter[1:-1], low, 0)
+    # Keep letters, plus the FIRST element of every non-letter run (the
+    # collapsed boundary); the wrapping zeros make edges uniform.
+    prev_letter = np.concatenate(([True], is_letter[:-1]))
+    keep = is_letter | prev_letter
+    return vals[keep]
 
 
 # Fixed-point scale for the log-prob table: scores are summed as exact int32
@@ -254,10 +298,9 @@ class LangIdModel:
         for letterless text.  Features are the character trigrams plus one
         whole-word hash per word.  Integer sums — the device kernel computes
         the same values exactly (:mod:`textblaster_tpu.ops.langid_tpu`)."""
-        cps = _normalize_codepoints(text)
-        if len(cps) < 3:
+        arr = _normalize_vec(text)
+        if arr.shape[0] < 3:
             return None
-        arr = np.asarray(cps, dtype=np.int64)
         h = _hash3_vec(arr)
         wh = _word_hash_vec(arr)
         scores = self.table_q[h].sum(axis=0, dtype=np.int64)
