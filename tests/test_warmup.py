@@ -1,0 +1,72 @@
+"""Concurrent AOT warmup must install programs the normal dispatch path uses.
+
+`CompiledPipeline.warmup_parallel` AOT-compiles every (bucket, phase)
+program on a thread pool and stores the Compiled executables in the same
+cache `dispatch_batch` consults — so a warmed pipeline must process
+documents without retracing, and its outcomes must equal a cold pipeline's.
+"""
+
+from textblaster_tpu.config.pipeline import parse_pipeline_config
+from textblaster_tpu.data_model import TextDocument
+from textblaster_tpu.ops.pipeline import CompiledPipeline, process_documents_device
+
+YAML = """
+pipeline:
+  - type: LanguageDetectionFilter
+    min_confidence: 0.5
+    allowed_languages: [ "dan", "eng" ]
+  - type: GopherQualityFilter
+    min_doc_words: 4
+    min_stop_words: 1
+    stop_words: [ "og", "the", "er", "i" ]
+  - type: FineWebQualityFilter
+    line_punct_thr: 0.1
+    line_punct_exclude_zero: false
+    short_line_thr: 0.95
+    short_line_length: 8
+    char_duplicates_ratio: 0.5
+    new_line_ratio: 0.5
+"""
+
+
+def _docs():
+    texts = [
+        "Det er en god dag og vi er glade for det i dag, siger han nu.",
+        "The quick brown fox jumps over the lazy dog and the bridge.",
+        "kort.",
+        "Mere tekst her. " * 25,
+    ]
+    return [
+        TextDocument(id=f"w{i}", source="s", content=texts[i % len(texts)])
+        for i in range(20)
+    ]
+
+
+def test_warmup_parallel_installs_dispatchable_programs():
+    config = parse_pipeline_config(YAML)
+    pipeline = CompiledPipeline(config, buckets=(256, 512), batch_size=16)
+    n_programs = len(pipeline.buckets) * len(pipeline.phases)
+    dt = pipeline.warmup_parallel()
+    assert dt >= 0.0
+    assert len(pipeline._jitted) == n_programs
+    # AOT Compiled objects, not jit wrappers: nothing left to trace.
+    assert all(not hasattr(f, "lower") for f in pipeline._jitted.values())
+
+    warmed = {
+        o.document.id: (o.kind, o.reason)
+        for o in process_documents_device(config, iter(_docs()), pipeline=pipeline)
+    }
+
+    cold_pipeline = CompiledPipeline(config, buckets=(256, 512), batch_size=16)
+    cold = {
+        o.document.id: (o.kind, o.reason)
+        for o in process_documents_device(
+            config, iter(_docs()), pipeline=cold_pipeline
+        )
+    }
+    assert warmed == cold
+
+    # Idempotent: a second call does not replace the compiled programs.
+    before = dict(pipeline._jitted)
+    pipeline.warmup_parallel()
+    assert pipeline._jitted == before
