@@ -45,9 +45,12 @@ SEED = 20260729
 
 # Long-doc config: fewer, much longer documents exercising the 8k-32k
 # buckets that dominate compile time and were previously unmeasured
-# (VERDICT r3 weak #9).
+# (VERDICT r3 weak #9).  The mid bucket matters: without 16384 the p50~13k
+# docs pad 2.4x and the scan-bound regime pays it directly (like-for-like
+# CPU A/B: 33.1 -> 39.7 docs/s; see TPU_EVIDENCE_r04.md for the stricter
+# full-corpus-oracle record).
 LONGDOC_N_DOCS = 512
-LONGDOC_BUCKETS = (8192, 32768)
+LONGDOC_BUCKETS = (8192, 16384, 32768)
 
 # Device batch rows.  Large batches amortize the remote tunnel's per-dispatch
 # round trip (~66ms) and upload latency (~65 MB/s measured); 1024 rows of the
