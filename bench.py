@@ -331,6 +331,35 @@ def main() -> int:
     os.chdir(os.path.dirname(os.path.abspath(__file__)))
     bench_name = _bench_name()
 
+    # Tell the background TPU-window prober (.scratch/tpu_prober.sh) a bench
+    # is in flight: its probe subprocess costs 20-40s of this box's single
+    # core and was the dominant measurement-noise source.  Freshness-checked
+    # on the prober side, so a crashed bench cannot wedge it.
+    import atexit
+
+    lock = os.path.join(".scratch", "bench_running.lock")
+
+    def _touch_lock():
+        try:
+            os.makedirs(".scratch", exist_ok=True)
+            with open(lock, "w") as f:
+                f.write(str(os.getpid()))
+        except OSError:
+            pass
+
+    def _drop_lock():
+        # Only remove our own lock: overlapping runs (on_window.sh suite +
+        # a manual invocation) must not unlock each other.
+        try:
+            with open(lock) as f:
+                if f.read().strip() == str(os.getpid()):
+                    os.remove(lock)
+        except OSError:
+            pass
+
+    _touch_lock()
+    atexit.register(_drop_lock)
+
     platform, probe_failures = _resolve_platform()
     _log(f"platform: {platform}")
     if platform == "cpu":
@@ -366,19 +395,20 @@ def main() -> int:
     _log(f"generated {len(docs)} docs (max {max(len(d.content) for d in docs)} chars)")
 
     # --- CPU oracle baseline (single process; the reference-equivalent path).
-    # Best-of-2 for both sides: this box has ONE core and a background TPU
+    # Best-of-3 for both sides: this box has ONE core and a background TPU
     # prober fires every ~3.5 min, so any single pass can eat a foreign
     # CPU burst.  Taking the best pass for the oracle AND the device path
     # applies the same rule to both sides of the ratio.
     executor = build_pipeline_from_config(config)
     cpu_elapsed = float("inf")
-    for _ in range(2):
+    for _ in range(3):
+        _touch_lock()  # keep the prober's 30-min freshness window alive
         sample = [d.copy() for d in docs[:cpu_sample]]
         t0 = time.perf_counter()
         host_outcomes = list(process_documents_host(executor, iter(sample)))
         cpu_elapsed = min(cpu_elapsed, time.perf_counter() - t0)
     cpu_rate = len(sample) / cpu_elapsed
-    _log(f"CPU oracle: {cpu_rate:.1f} docs/s over {len(sample)} docs (best of 2)")
+    _log(f"CPU oracle: {cpu_rate:.1f} docs/s over {len(sample)} docs (best of 3)")
 
     # --- Device path: warmup (compile) then timed run.  ONE CompiledPipeline
     # serves both, so the timed run executes already-warmed programs and
@@ -410,7 +440,8 @@ def main() -> int:
     fallbacks_before = METRICS.get("worker_host_fallback_total")
     tails_before = METRICS.get("worker_host_tail_total")
     dev_elapsed = float("inf")
-    for _ in range(2):
+    for _ in range(3):
+        _touch_lock()  # long cold warmups can outlive the freshness window
         run_docs = [d.copy() for d in docs]
         t0 = time.perf_counter()
         dev_outcomes = list(
@@ -418,17 +449,17 @@ def main() -> int:
         )
         dev_elapsed = min(dev_elapsed, time.perf_counter() - t0)
     dev_rate = len(run_docs) / dev_elapsed
-    _log(f"device: {dev_rate:.1f} docs/s over {len(run_docs)} docs (best of 2)")
-    # Read the honesty counters HERE: they must cover exactly the 2 timed
+    _log(f"device: {dev_rate:.1f} docs/s over {len(run_docs)} docs (best of 3)")
+    # Read the honesty counters HERE: they must cover exactly the 3 timed
     # passes, not the parity pass below (which also re-runs fallbacks).
     fallback_frac = round(
         (METRICS.get("worker_host_fallback_total") - fallbacks_before)
-        / max(2 * len(run_docs), 1),
+        / max(3 * len(run_docs), 1),
         4,
     )
     tail_frac = round(
         (METRICS.get("worker_host_tail_total") - tails_before)
-        / max(2 * len(run_docs), 1),
+        / max(3 * len(run_docs), 1),
         4,
     )
 
@@ -478,7 +509,7 @@ def main() -> int:
         "warmup_s": round(warmup_s, 1),
         "warmup_compile_s": round(compile_s, 1),
         # Docs the device path re-ran on the host oracle (outliers / table
-        # overflow) during the 2 timed passes.  A high rate means the
+        # overflow) during the 3 timed passes.  A high rate means the
         # headline number is partly the Python path — it must stay near zero
         # for the record to be honest.
         "host_fallback_frac": fallback_frac,
