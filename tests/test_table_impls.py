@@ -109,8 +109,15 @@ def _k_misc(cps, lengths):
 
 def _run(kernel, impl, cps, lengths, monkeypatch):
     monkeypatch.setenv("TEXTBLAST_TABLE_IMPL", impl)
-    # Fresh jit wrapper: the impl choice is read at trace time.
-    return jax.device_get(jax.jit(kernel)(cps, lengths))
+
+    # A FRESH function object per run: jax.jit caches compiled executables
+    # keyed on the underlying function, so re-wrapping the same module-level
+    # kernel after an env flip would silently return the previous impl's
+    # cached result and make the comparison vacuous (caught by review).
+    def fresh(c, l):
+        return kernel(c, l)
+
+    return jax.device_get(jax.jit(fresh)(cps, lengths))
 
 
 @pytest.mark.parametrize("kernel", [_k_rep, _k_fw, _k_c4, _k_misc])
@@ -118,6 +125,34 @@ def test_sort_tables_match_scatter(kernel, monkeypatch):
     batch = _batch()
     ref = _run(kernel, "scatter", batch.cps, batch.lengths, monkeypatch)
     got = _run(kernel, "sort", batch.cps, batch.lengths, monkeypatch)
+    assert set(ref) == set(got)
+    for k in ref:
+        np.testing.assert_array_equal(
+            np.asarray(ref[k]), np.asarray(got[k]), err_msg=k
+        )
+
+
+@pytest.mark.parametrize("kernel", [_k_rep, _k_fw, _k_c4, _k_misc])
+def test_chunk_scan_matches_default(kernel, monkeypatch):
+    """The blocked `chunk` scan schedule (TEXTBLAST_SCAN_IMPL=chunk) must be
+    bit-identical to the default schedule across every kernel — any scan
+    schedule computes the same values for associative monoids, and this pins
+    the implementation to that promise (incl. padding of non-multiple
+    lengths and segmented resets)."""
+    batch = _batch()
+
+    def fresh_ref(c, l):  # fresh fn objects per impl — see _run
+        return kernel(c, l)
+
+    def fresh_chunk(c, l):
+        return kernel(c, l)
+
+    monkeypatch.delenv("TEXTBLAST_SCAN_IMPL", raising=False)
+    ref = jax.device_get(jax.jit(fresh_ref)(batch.cps, batch.lengths))
+    monkeypatch.setenv("TEXTBLAST_SCAN_IMPL", "chunk")
+    # Odd chunk size forces in-chunk padding; 48 < 512/2 engages the path.
+    monkeypatch.setenv("TEXTBLAST_SCAN_CHUNK", "48")
+    got = jax.device_get(jax.jit(fresh_chunk)(batch.cps, batch.lengths))
     assert set(ref) == set(got)
     for k in ref:
         np.testing.assert_array_equal(

@@ -127,7 +127,7 @@ MID_ALL_CPS = np.sort(np.array([ord(c) for c in _MID_ALL], dtype=np.int32))
 # State (v, r): r = "resets here".  Composition is the standard segmented-scan
 # monoid; associative, so any scan schedule computes the same values.
 #
-# Two schedules are provided:
+# Three schedules are provided:
 #
 # * ``assoc`` — ``jax.lax.associative_scan`` (work-efficient odd/even
 #   recursion).  Its stride-2 slices relayout on TPU's tiled [sublane, lane]
@@ -137,9 +137,16 @@ MID_ALL_CPS = np.sort(np.array([ord(c) for c in _MID_ALL], dtype=np.int32))
 #   with ``i - d`` via a pad+slice shift (contiguous, layout-preserving).
 #   O(L log L) work instead of O(L), but every step is a cheap contiguous
 #   move — the TPU-friendly schedule.
+# * ``chunk`` — blocked three-phase scan: reshape ``[B, L]`` to chunks
+#   ``[C, B, n]``, one ``lax.scan`` over the C in-chunk positions (carry
+#   ``[B, n]`` — every row and chunk advances in lockstep), a tiny
+#   cross-chunk prefix over ``n``, and one broadcast combine.  ~O(2L) work
+#   and ~4 full-array memory passes versus shift's log L — the candidate
+#   replacement wherever scan passes dominate; kept opt-in until measured
+#   on silicon (microbench3).
 #
-# ``TEXTBLAST_SCAN_IMPL`` (assoc|shift) pins one; default picks by backend at
-# trace time (shift on tpu-like backends, assoc elsewhere).
+# ``TEXTBLAST_SCAN_IMPL`` (assoc|shift|chunk) pins one; default picks by
+# backend at trace time (shift on tpu-like backends, assoc elsewhere).
 
 
 def _seg_add_op(a, b):
@@ -167,15 +174,17 @@ def _latch_op(a, b):
     return jnp.where(br, bv, av), ar | br
 
 
-def _use_shift_scan() -> bool:
+def _scan_impl() -> str:
     import os
 
     impl = os.environ.get("TEXTBLAST_SCAN_IMPL", "")
-    if impl == "shift":
-        return True
-    if impl == "assoc":
-        return False
-    return jax.default_backend() in ("tpu", "axon")
+    if impl in ("shift", "assoc", "chunk"):
+        return impl
+    return "shift" if jax.default_backend() in ("tpu", "axon") else "assoc"
+
+
+def _use_shift_scan() -> bool:
+    return _scan_impl() == "shift"
 
 
 def shift_scan_tuple(op, identities, xs, axis: int = 1):
@@ -213,12 +222,84 @@ def shift_scan_tuple(op, identities, xs, axis: int = 1):
     return xs
 
 
+def _ident_block(ident, like: jax.Array, shape) -> jax.Array:
+    if isinstance(ident, (int, bool, np.integer, np.bool_)):
+        return jnp.full(shape, ident, dtype=like.dtype)
+    return jnp.broadcast_to(ident, shape).astype(like.dtype)
+
+
+def chunk_scan_tuple(op, identities, xs, axis: int = 1, chunk_size: int = 0):
+    """Inclusive tuple-state scan via the blocked three-phase schedule (see
+    scan notes above): one ``lax.scan`` over in-chunk positions with a
+    ``[B, n_chunks]`` carry, a small cross-chunk prefix, one combine."""
+    import os
+
+    if chunk_size <= 0:
+        chunk_size = int(os.environ.get("TEXTBLAST_SCAN_CHUNK", "128"))
+    if axis != 1:
+        xs = tuple(jnp.moveaxis(x, axis, 1) for x in xs)
+    b, length = xs[0].shape[0], xs[0].shape[1]
+    if length <= 2 * chunk_size:
+        out = shift_scan_tuple(op, identities, xs, axis=1)
+        return out if axis == 1 else tuple(jnp.moveaxis(x, 1, axis) for x in out)
+    n = -(-length // chunk_size)
+    pad = n * chunk_size - length
+
+    xs3 = []
+    for x, ident in zip(xs, identities):
+        if pad:
+            blk = _ident_block(ident, x, (b, pad) + x.shape[2:])
+            x = jnp.concatenate([x, blk], axis=1)
+        x = x.reshape((b, n, chunk_size) + x.shape[2:])
+        xs3.append(jnp.moveaxis(x, 2, 0))  # [C, b, n, *rest]
+    xs3 = tuple(xs3)
+
+    init = tuple(
+        _ident_block(ident, x, (x.shape[1], x.shape[2]) + x.shape[3:])
+        for x, ident in zip(xs3, identities)
+    )
+
+    def step(carry, xc):
+        new = op(carry, xc)
+        return new, new
+
+    _, ys = jax.lax.scan(step, init, xs3)  # each [C, b, n, *rest]
+
+    # Cross-chunk exclusive prefix of the chunk summaries (tiny: [b, n]).
+    sums = tuple(y[-1] for y in ys)
+    inc = shift_scan_tuple(op, identities, sums, axis=1)
+    exc = tuple(
+        jnp.concatenate(
+            [_ident_block(ident, i, (b, 1) + i.shape[2:]), i[:, :-1]], axis=1
+        )
+        for i, ident in zip(inc, identities)
+    )
+    exc_b = tuple(jnp.broadcast_to(e, y.shape) for e, y in zip(exc, ys))
+    final = op(exc_b, ys)
+
+    outs = []
+    for f in final:
+        f = jnp.moveaxis(f, 0, 2).reshape((b, n * chunk_size) + f.shape[3:])
+        outs.append(f[:, :length])
+    outs = tuple(outs)
+    return outs if axis == 1 else tuple(jnp.moveaxis(x, 1, axis) for x in outs)
+
+
 def _seg_scan(op, identity, values: jax.Array, reset: jax.Array, axis: int):
-    if _use_shift_scan():
+    impl = _scan_impl()
+    if impl == "shift":
         # Virtual elements left of position 0 are (op identity, reset=True):
         # the identity keeps in-range prefixes exact, the True seals the
         # boundary for later levels.
         v, _ = shift_scan_tuple(op, (identity, True), (values, reset), axis)
+        return v
+    if impl == "chunk":
+        # The chunk schedule needs the TRUE left identity (reset=False):
+        # its identities seed every chunk's carry and the cross-chunk
+        # prefix, where a sealing True would cut segments at chunk
+        # boundaries (shift's virtual elements sit only left of position 0,
+        # where sealing is harmless).
+        v, _ = chunk_scan_tuple(op, (identity, False), (values, reset), axis)
         return v
     out, _ = jax.lax.associative_scan(op, (values, reset), axis=axis)
     return out
@@ -231,12 +312,15 @@ def assoc_scan1(op, identity, x: jax.Array, axis: int = 1) -> jax.Array:
     ``identity`` is ``op``'s identity: a scalar, or an array broadcastable to
     a ``[B, d, ...]`` pad block (e.g. an iota for function-composition scans).
     """
-    if not _use_shift_scan():
+    impl = _scan_impl()
+    if impl == "assoc":
         return jax.lax.associative_scan(op, x, axis=axis)
 
     def tuple_op(a, b):
         return (op(a[0], b[0]),)
 
+    if impl == "chunk":
+        return chunk_scan_tuple(tuple_op, (identity,), (x,), axis)[0]
     return shift_scan_tuple(tuple_op, (identity,), (x,), axis)[0]
 
 

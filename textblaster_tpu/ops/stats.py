@@ -121,13 +121,15 @@ def _poly_hash_many(
         my, ays = y[0], y[1:]
         return (mx * my,) + tuple(ay + my * ax for ax, ay in zip(axs, ays))
 
-    from .device import _use_shift_scan, shift_scan_tuple
+    from .device import _scan_impl, chunk_scan_tuple, shift_scan_tuple
 
-    if _use_shift_scan():
+    impl = _scan_impl()
+    if impl != "assoc":
         # Affine identity is (m=1, a=0, ...) — one shared scan schedule
-        # (device.shift_scan_tuple).
+        # (device.shift_scan_tuple / chunk_scan_tuple).
         identities = (1,) + tuple(0 for _ in accs)
-        return shift_scan_tuple(compose, identities, (m,) + accs, axis=1)[1:]
+        fn = chunk_scan_tuple if impl == "chunk" else shift_scan_tuple
+        return fn(compose, identities, (m,) + accs, axis=1)[1:]
 
     out = jax.lax.associative_scan(compose, (m,) + accs, axis=1)
     return out[1:]
