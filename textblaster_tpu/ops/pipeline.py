@@ -181,6 +181,7 @@ class _StepEval:
         "pass_stamps",
         "c4_line_keep",
         "c4_n_lines",
+        "c4_rewrite_identity",
         "badwords_matches",
         "badwords_default_language",
     )
@@ -194,6 +195,7 @@ class _StepEval:
         self.pass_stamps = pass_stamps
         self.c4_line_keep = None
         self.c4_n_lines = None
+        self.c4_rewrite_identity = None
         self.badwords_matches = None
         self.badwords_default_language = None
 
@@ -781,6 +783,7 @@ class CompiledPipeline:
     def _eval_c4(self, step: StepConfig, idx: int, stats) -> "_StepEval":
         p = step.params
         overflow = np.asarray(stats[f"{idx}:line_overflow"], dtype=bool)
+        rewrite_identity = np.asarray(stats[f"{idx}:rewrite_identity"], dtype=bool)
         lorem = np.asarray(stats[f"{idx}:has_lorem"], dtype=bool)
         curly = np.asarray(stats[f"{idx}:has_curly"], dtype=bool)
         early = lorem | curly
@@ -826,7 +829,10 @@ class CompiledPipeline:
                 False,
                 rs,
                 stamps,
-                extra={"rewrite": True, "keep_mask": line_keep[row][: n_lines[row]]},
+                extra={
+                    "rewrite": not rewrite_identity[row],
+                    "keep_mask": line_keep[row][: n_lines[row]],
+                },
             )
 
         ev = _StepEval(
@@ -837,6 +843,7 @@ class CompiledPipeline:
         )
         ev.c4_line_keep = line_keep
         ev.c4_n_lines = n_lines
+        ev.c4_rewrite_identity = rewrite_identity
         return ev
 
     def _eval_badwords(self, step: StepConfig, idx: int, stats) -> "_StepEval":
@@ -1163,7 +1170,10 @@ class CompiledPipeline:
             elif ev.passed[row] and ev.pass_stamps is not None:
                 for k, v in ev.pass_stamps:
                     doc.metadata[k] = v
-                if ev.c4_line_keep is not None:
+                if ev.c4_line_keep is not None and not ev.c4_rewrite_identity[row]:
+                    # Identity rewrites (every line kept, already trimmed —
+                    # the common clean-text case) skip the per-doc Python
+                    # string rebuild; the device proved content equality.
                     self._rewrite_c4(
                         doc, step, ev.c4_line_keep[row][: ev.c4_n_lines[row]]
                     )

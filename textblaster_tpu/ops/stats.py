@@ -1284,11 +1284,18 @@ def c4_stage(
 
     n_sent = sentence_counts(c2_cps, c2_len)
 
+    # Rewrite-identity flag: the rewritten batch equals this stage's input
+    # (both zero-padded), so the host can skip its per-document Python
+    # string rebuild — the common case on clean text, where every line is
+    # kept and already trimmed.
+    rewrite_identity = (c2_len == lengths) & jnp.all(c2_cps == cps, axis=1)
+
     false_b = jnp.zeros_like(has_lorem)
     stats = {
         "has_lorem": has_lorem if params.filter_lorem_ipsum else false_b,
         "has_curly": has_curly if params.filter_curly_bracket else false_b,
         "n_sentences": n_sent,
+        "rewrite_identity": rewrite_identity,  # [B]
         "line_keep": line_keep,  # [B, ML]
         "n_lines": jnp.minimum(n_lines1, jnp.int32(max_lines)),
         "drop_too_long": jnp.sum(drop_too_long, axis=1).astype(jnp.int32),
