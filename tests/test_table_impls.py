@@ -158,3 +158,44 @@ def test_chunk_scan_matches_default(kernel, monkeypatch):
         np.testing.assert_array_equal(
             np.asarray(ref[k]), np.asarray(got[k]), err_msg=k
         )
+
+
+def test_chunk_scan_tuple_direct():
+    """Direct unit pin of chunk_scan_tuple against the shift schedule:
+    random segmented add/max/latch streams (scalar identities) and a
+    function-composition scan with an iota array identity + trailing dims —
+    odd lengths force the padding path."""
+    import jax.numpy as jnp
+
+    from textblaster_tpu.ops.device import (
+        _latch_op,
+        _seg_add_op,
+        _seg_max_op,
+        chunk_scan_tuple,
+        shift_scan_tuple,
+    )
+
+    rng = np.random.default_rng(3)
+    for length in (7, 48, 96, 131, 513):
+        vals = jnp.asarray(rng.integers(0, 100, (4, length), dtype=np.int32))
+        reset = jnp.asarray(rng.random((4, length)) < 0.15)
+        for op, ident in ((_seg_add_op, 0), (_seg_max_op, -(2**31)), (_latch_op, 0)):
+            want = shift_scan_tuple(op, (ident, False), (vals, reset))
+            got = chunk_scan_tuple(op, (ident, False), (vals, reset), chunk_size=16)
+            for w, g in zip(want, got):
+                np.testing.assert_array_equal(np.asarray(w), np.asarray(g))
+
+    # Function composition with trailing state dim: f_i : [N] -> [N] maps,
+    # composed left-to-right (the dfa_states >8-states shape).
+    n_states = 5
+    fns = jnp.asarray(rng.integers(0, n_states, (3, 67, n_states), dtype=np.int32))
+    iota = jnp.arange(n_states, dtype=jnp.int32)
+
+    def compose(a, b):
+        # take_along_axis needs equal ranks; chunk broadcasts operands first.
+        a0, b0 = jnp.broadcast_arrays(a[0], b[0])
+        return (jnp.take_along_axis(b0, a0, axis=-1),)
+
+    want = shift_scan_tuple(compose, (iota,), (fns,))[0]
+    got = chunk_scan_tuple(compose, (iota,), (fns,), chunk_size=8)[0]
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
