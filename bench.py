@@ -308,7 +308,32 @@ pipeline:
     min_confidence: 0.65
     allowed_languages: [eng]
 """,
+    # C4BadWordsFilter at realistic list scale (~400 entries, ~20 distinct
+    # pattern lengths — the per-length window-hash pass count is the device
+    # cost driver; VERDICT r4 item 4).  The list is generated at bench start
+    # (utils/synthwords.py) and wired via cache_base_path in _load_config.
+    "badwords": """
+pipeline:
+  - type: C4BadWordsFilter
+    default_language: en
+    keep_fraction: 0.0
+    fail_on_missing_language: true
+""",
 }
+
+_BADWORDS_SEED = 515
+
+
+def _badwords_cache_dir():
+    import pathlib
+
+    d = pathlib.Path(".scratch") / "bench_badwords_cache"
+    d.mkdir(parents=True, exist_ok=True)
+    from textblaster_tpu.utils.synthwords import synth_badwords
+
+    words = synth_badwords(_BADWORDS_SEED, n=400)
+    (d / "en").write_text("\n".join(words) + "\n", encoding="utf-8")
+    return d, words
 
 
 def _load_config(name: str):
@@ -316,6 +341,10 @@ def _load_config(name: str):
 
     import yaml as _yaml
 
+    if name == "badwords":
+        config = parse_pipeline_config(_BENCH_CONFIGS[name])
+        config.pipeline[0].params.cache_base_path, _ = _badwords_cache_dir()
+        return config
     if name in _BENCH_CONFIGS:
         return parse_pipeline_config(_BENCH_CONFIGS[name])
     # "full" / "longdoc": the shipped Danish pipeline minus TokenCounter
@@ -325,6 +354,80 @@ def _load_config(name: str):
         raw = _yaml.safe_load(f)
     raw["pipeline"] = [s for s in raw["pipeline"] if s["type"] != "TokenCounter"]
     return parse_pipeline_config(_yaml.safe_dump(raw))
+
+
+def _fleet_child(name: str, k: int, n: int) -> None:
+    """One fleet worker: build the oracle pipeline, process docs[k::n].
+
+    Setup (imports, doc generation) happens before READY; the timed region
+    is only the processing loop, so the measurement isolates steady-state
+    contention from Python startup (both matter for a real fleet, but the
+    reference's workers are long-lived — startup amortizes to zero there)."""
+    os.chdir(os.path.dirname(os.path.abspath(__file__)))
+    from textblaster_tpu.utils.backend_guard import force_cpu_backend
+
+    force_cpu_backend()
+    from textblaster_tpu.orchestration import process_documents_host
+    from textblaster_tpu.pipeline_builder import build_pipeline_from_config
+
+    config = _load_config(name)
+    executor = build_pipeline_from_config(config)
+    rng = np.random.default_rng(SEED)
+    docs = (_make_longdocs(rng) if name == "longdoc" else _make_docs(rng))[k::n]
+    print("READY", flush=True)
+    sys.stdin.readline()
+    t0 = time.perf_counter()
+    outcomes = list(process_documents_host(executor, iter(docs)))
+    print(
+        json.dumps(
+            {"n": len(outcomes), "elapsed": round(time.perf_counter() - t0, 3)}
+        ),
+        flush=True,
+    )
+
+
+def _measure_fleet(name: str, n_workers: int):
+    """Aggregate oracle docs/s with ``n_workers`` concurrent single-thread
+    processes on this box.  Returns (aggregate_rate, per_child) or None."""
+    import subprocess as sp
+
+    procs = []
+    try:
+        for k in range(n_workers):
+            procs.append(
+                sp.Popen(
+                    [
+                        sys.executable,
+                        "-c",
+                        f"import bench; bench._fleet_child({name!r}, {k}, {n_workers})",
+                    ],
+                    stdin=sp.PIPE,
+                    stdout=sp.PIPE,
+                    stderr=sp.DEVNULL,
+                    text=True,
+                )
+            )
+        for p in procs:
+            line = p.stdout.readline()
+            if line.strip() != "READY":
+                raise RuntimeError(f"fleet child failed: {line!r}")
+        t0 = time.perf_counter()
+        for p in procs:
+            p.stdin.write("go\n")
+            p.stdin.flush()
+        per_child = [json.loads(p.stdout.readline()) for p in procs]
+        wall = time.perf_counter() - t0
+        for p in procs:
+            p.wait(timeout=60)
+        total_docs = sum(c["n"] for c in per_child)
+        return total_docs / wall, per_child
+    except Exception as e:  # noqa: BLE001
+        _log(f"fleet measurement failed: {e}")
+        return None
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
 
 
 def main() -> int:
@@ -391,6 +494,16 @@ def main() -> int:
 
     rng = np.random.default_rng(SEED)
     docs = _make_longdocs(rng) if bench_name == "longdoc" else _make_docs(rng)
+    if bench_name == "badwords":
+        _, _bw_words = _badwords_cache_dir()
+        # ~5% of docs get a real (boundary-separated) list hit; ~0.5% get a
+        # fold-hazard codepoint so the host-routing tax is measured honestly.
+        for d in docs:
+            r = rng.random()
+            if r < 0.05:
+                d.content += " " + _bw_words[int(rng.integers(0, len(_bw_words)))]
+            elif r < 0.055:
+                d.content += " ſ"
     cpu_sample = min(CPU_SAMPLE, len(docs))
     _log(f"generated {len(docs)} docs (max {max(len(d.content) for d in docs)} chars)")
 
@@ -398,17 +511,63 @@ def main() -> int:
     # Best-of-3 for both sides: this box has ONE core and a background TPU
     # prober fires every ~3.5 min, so any single pass can eat a foreign
     # CPU burst.  Taking the best pass for the oracle AND the device path
-    # applies the same rule to both sides of the ratio.
+    # applies the same rule to both sides of the ratio; the per-pass raw
+    # times and the 1-minute load average bracketing each side are recorded
+    # so a contaminated record is *visibly* contaminated (VERDICT r4 item 3:
+    # two rounds of driver-vs-evidence disagreement traced to foreign CPU
+    # bursts landing inside one side's passes).
     executor = build_pipeline_from_config(config)
-    cpu_elapsed = float("inf")
+    load_before_oracle = os.getloadavg()[0]
+    oracle_pass_s = []
     for _ in range(3):
         _touch_lock()  # keep the prober's 30-min freshness window alive
         sample = [d.copy() for d in docs[:cpu_sample]]
         t0 = time.perf_counter()
         host_outcomes = list(process_documents_host(executor, iter(sample)))
-        cpu_elapsed = min(cpu_elapsed, time.perf_counter() - t0)
+        oracle_pass_s.append(round(time.perf_counter() - t0, 3))
+    load_after_oracle = os.getloadavg()[0]
+    cpu_elapsed = min(oracle_pass_s)
     cpu_rate = len(sample) / cpu_elapsed
-    _log(f"CPU oracle: {cpu_rate:.1f} docs/s over {len(sample)} docs (best of 3)")
+    _log(
+        f"CPU oracle: {cpu_rate:.1f} docs/s over {len(sample)} docs "
+        f"(passes {oracle_pass_s}, load {load_before_oracle:.2f}->"
+        f"{load_after_oracle:.2f})"
+    )
+
+    # --- Fleet scaling measurement (VERDICT r4 item 9): the north-star
+    # denominator is a 32-worker fleet, previously modeled as a pure 32x of
+    # the single-core oracle.  Measure what concurrent worker processes
+    # actually deliver on THIS box (full config only; BENCH_FLEET=0 skips).
+    # On a 1-core box the workers time-slice one core, so the measured
+    # aggregate is NOT a fleet measurement — it bounds scheduling+I/O
+    # overhead, and the 32x-linear model stays as the (disclosed) upper
+    # bound a real 32-core fleet cannot exceed.
+    fleet = None
+    if bench_name == "full" and os.environ.get("BENCH_FLEET", "1") != "0":
+        measured = {}
+        for n_workers in (2,):
+            r = _measure_fleet(bench_name, n_workers)
+            if r is not None:
+                measured[str(n_workers)] = round(r[0], 2)
+        if measured:
+            n_cores = os.cpu_count() or 1
+            fleet = {
+                "workers_measured_docs_per_sec": measured,
+                "singleproc_docs_per_sec": round(cpu_rate, 2),
+                "box_cores": n_cores,
+                "parallel_efficiency_2proc": round(
+                    measured.get("2", 0.0) / cpu_rate, 3
+                ),
+                "model": "north_star = 32 x single-core oracle (upper bound)",
+                "confound": (
+                    "1-core box: concurrent workers time-slice the core; a "
+                    "real fleet gives each worker its own core, so measured "
+                    "aggregate here is a lower bound on per-worker efficiency"
+                    if n_cores < 2
+                    else "multi-core box: curve is directly meaningful"
+                ),
+            }
+            _log(f"fleet scaling: {fleet['workers_measured_docs_per_sec']}")
 
     # --- Device path: warmup (compile) then timed run.  ONE CompiledPipeline
     # serves both, so the timed run executes already-warmed programs and
@@ -439,7 +598,8 @@ def main() -> int:
 
     fallbacks_before = METRICS.get("worker_host_fallback_total")
     tails_before = METRICS.get("worker_host_tail_total")
-    dev_elapsed = float("inf")
+    load_before_dev = os.getloadavg()[0]
+    device_pass_s = []
     for _ in range(3):
         _touch_lock()  # long cold warmups can outlive the freshness window
         run_docs = [d.copy() for d in docs]
@@ -447,9 +607,15 @@ def main() -> int:
         dev_outcomes = list(
             process_documents_device(config, iter(run_docs), pipeline=pipeline)
         )
-        dev_elapsed = min(dev_elapsed, time.perf_counter() - t0)
+        device_pass_s.append(round(time.perf_counter() - t0, 3))
+    load_after_dev = os.getloadavg()[0]
+    dev_elapsed = min(device_pass_s)
     dev_rate = len(run_docs) / dev_elapsed
-    _log(f"device: {dev_rate:.1f} docs/s over {len(run_docs)} docs (best of 3)")
+    _log(
+        f"device: {dev_rate:.1f} docs/s over {len(run_docs)} docs "
+        f"(passes {device_pass_s}, load {load_before_dev:.2f}->"
+        f"{load_after_dev:.2f})"
+    )
     # Read the honesty counters HERE: they must cover exactly the 3 timed
     # passes, not the parity pass below (which also re-runs fallbacks).
     fallback_frac = round(
@@ -488,11 +654,36 @@ def main() -> int:
     )
     parity = agree / max(len(host_by_id), 1)
 
+    # Noise self-diagnosis: spreads over the raw passes plus the load
+    # averages bracketing each side.  The bench's own process keeps a 1-core
+    # box at load ~1; sustained load beyond ~1.8 means a foreign process was
+    # competing during that side's passes and the ratio is suspect.
+    oracle_spread = round((max(oracle_pass_s) - cpu_elapsed) / cpu_elapsed, 3)
+    device_spread = round((max(device_pass_s) - dev_elapsed) / dev_elapsed, 3)
+    noise_flags = []
+    if max(load_before_oracle, load_after_oracle) > 1.8:
+        noise_flags.append("oracle_load_high")
+    if max(load_before_dev, load_after_dev) > 1.8:
+        noise_flags.append("device_load_high")
+    if oracle_spread > 0.2:
+        noise_flags.append("oracle_spread_high")
+    if device_spread > 0.2:
+        noise_flags.append("device_spread_high")
+
     result = {
         "metric": _metric_name(bench_name),
         "value": round(dev_rate, 2),
         "unit": "docs/s",
         "vs_baseline": round(dev_rate / cpu_rate, 3),
+        "oracle_pass_s": oracle_pass_s,
+        "device_pass_s": device_pass_s,
+        "oracle_spread": oracle_spread,
+        "device_spread": device_spread,
+        "load_1m": {
+            "oracle": [round(load_before_oracle, 2), round(load_after_oracle, 2)],
+            "device": [round(load_before_dev, 2), round(load_after_dev, 2)],
+        },
+        "noise_flags": noise_flags,
         "cpu_baseline_docs_per_sec": round(cpu_rate, 2),
         # The BASELINE.json north star divides by a 32-worker CPU fleet.  The
         # reference's workers are embarrassingly parallel (one queue, no
@@ -502,6 +693,7 @@ def main() -> int:
         "cpu_baseline_workers": 1,
         "north_star_docs_per_sec": round(32 * cpu_rate, 2),
         "vs_32_worker_fleet": round(dev_rate / (32 * cpu_rate), 4),
+        **({"fleet_scaling": fleet} if fleet else {}),
         "decision_parity": round(parity, 6),
         "parity_denominator": len(host_by_id),
         "n_docs": len(run_docs),

@@ -13,7 +13,11 @@ import pytest
 from textblaster_tpu.config.pipeline import parse_pipeline_config
 from textblaster_tpu.data_model import ProcessingOutcome, TextDocument
 from textblaster_tpu.filters.c4_badwords import load_local_badwords
-from textblaster_tpu.ops.badwords import BadwordTables, badwords_matches
+from textblaster_tpu.ops.badwords import (
+    BadwordTables,
+    badwords_matches,
+    badwords_matches_multi,
+)
 from textblaster_tpu.ops.pipeline import CompiledPipeline, process_documents_device
 from textblaster_tpu.orchestration import process_documents_host
 from textblaster_tpu.pipeline_builder import build_pipeline_from_config
@@ -42,14 +46,14 @@ def test_candidates_with_boundaries():
         "bad",                         # the whole row
         "",                            # empty row
     ]
-    got = np.asarray(badwords_matches(*_pack(texts), tables))
+    got = np.asarray(badwords_matches(*_pack(texts), tables)[0])
     assert got.tolist() == [True, True, False, False, False, True, True, True, False]
 
 
 def test_candidates_cjk_no_boundaries():
     tables = BadwordTables.build(["悪い"], check_boundaries=False)
     texts = ["これは悪い言葉です", "これは良い言葉です"]
-    got = np.asarray(badwords_matches(*_pack(texts), tables))
+    got = np.asarray(badwords_matches(*_pack(texts), tables)[0])
     assert got.tolist() == [True, False]
 
 
@@ -70,7 +74,7 @@ def test_matches_equal_regex_matches():
         " ".join(vocab[j] for j in rng.integers(0, len(vocab), size=8))
         for _ in range(128)
     ]
-    got = np.asarray(badwords_matches(*_pack(texts), tables))
+    got = np.asarray(badwords_matches(*_pack(texts), tables)[0])
     for t, flag in zip(texts, got):
         assert bool(flag) == bool(pattern.search(t)), t
 
@@ -266,3 +270,80 @@ pipeline:
     assert hmap == dmap  # backend-independent
     kinds = [hmap[f"d{i}"] for i, t in enumerate(texts) if "sex" in t]
     assert len(set(kinds)) == 2  # keep_fraction actually kept and dropped some
+
+
+def test_fold_divergent_patterns_disqualify():
+    # A pattern whose IGNORECASE divergence partner is a COMMON codepoint
+    # cannot be device-compiled without host-routing ordinary text, so the
+    # list falls back to the host regex wholesale; rare-sided divergences
+    # stay compiled with a per-list hazard set (ADVICE r4 / _fold_partners).
+    assert BadwordTables.build(["\u017ftop"], check_boundaries=True) is None
+    assert BadwordTables.build(["\u0130stanbul"], check_boundaries=True) is None
+    # Greek sigma's partner is final sigma (rare side) -> compiled + hazard.
+    t = BadwordTables.build(["\u03c3\u03c0\u03b1\u03bc"], check_boundaries=True)
+    assert t is not None and 0x3C2 in t.hazard_cps
+    # Kelvin sign lowers to 'k' in one char -- the table expresses it fine,
+    # and an s/i-free pattern has no hazard at all.
+    t = BadwordTables.build(["kelvon"], check_boundaries=True)
+    assert t is not None and t.hazard_cps == ()
+    # English-like pattern with s and i: hazards are exactly the rare
+    # partners (long s, dotless i, dotted I) -- nothing common is flagged.
+    t = BadwordTables.build(["sin"], check_boundaries=True)
+    assert t is not None
+    assert set(t.hazard_cps) == {0x131, 0x130, 0x17F}
+
+
+def test_fold_hazard_rows_decided_by_host(tmp_path):
+    # '\u017fex' matches (?i)sex under re (s == U+017F long s); the device
+    # table keeps U+017F as-is so the kernel would miss it.  The row must be
+    # flagged fold_hazard and re-decided by the host regex -- end-to-end the
+    # device path must agree with the pure-host oracle.
+    (tmp_path / "en").write_text("sex\nbadword\n", encoding="utf-8")
+    config = parse_pipeline_config(CONFIG)
+    config.pipeline[0].params.cache_base_path = tmp_path
+    texts = [
+        "a \u017fex document using the long s",  # regex match only via fold
+        "a \u017fimple clean document",          # hazard char, no match
+        "plain sex mention",                  # ordinary device-visible match
+        "plain clean text",                   # ordinary pass
+        "273 \u212aelvin units of kelvin",       # Kelvin sign: device handles it
+    ]
+    docs_h = [_mk(i, t) for i, t in enumerate(texts)]
+    docs_d = [_mk(i, t) for i, t in enumerate(texts)]
+    executor = build_pipeline_from_config(config)
+    host = {o.document.id: o for o in process_documents_host(executor, iter(docs_h))}
+    dev = {
+        o.document.id: o
+        for o in process_documents_device(config, iter(docs_d))
+    }
+    assert host["d0"].kind == ProcessingOutcome.FILTERED  # fold-only match
+    assert set(host) == set(dev)
+    for k in host:
+        assert host[k].kind == dev[k].kind, k
+        assert host[k].reason == dev[k].reason, k
+        assert (
+            host[k].document.metadata.get("c4_badwords_filter_status")
+            == dev[k].document.metadata.get("c4_badwords_filter_status")
+        ), k
+
+
+def test_fold_hazard_flag_surface():
+    # The kernel flags exactly the rows containing a hazard codepoint for
+    # the compiled pattern set; Kelvin-sign rows are clean (its fold is
+    # table-expressible) and s/i-free patterns flag nothing at all.
+    tables = BadwordTables.build(["sin"], check_boundaries=True)
+    texts = [
+        "with \u017f char",
+        "plain text",
+        "\u212a kelvin",
+        "\u0130 dotted",
+        "\u0131 dotless",
+    ]
+    no_si = BadwordTables.build(["gz"], check_boundaries=True)
+    per_lang, hazards = badwords_matches_multi(
+        *_pack(texts), {"en": tables, "xx": no_si}
+    )
+    # Hazards are per-language: the s/i list flags its rare partners, the
+    # s/i-free list flags nothing on the very same rows.
+    assert np.asarray(hazards["en"]).tolist() == [True, False, False, True, True]
+    assert not np.asarray(hazards["xx"]).any()

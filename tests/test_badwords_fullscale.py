@@ -1,0 +1,212 @@
+"""Full-scale badwords device tables: realistic list sizes, many distinct
+lengths, multi-language including CJK (VERDICT r4 item 4).
+
+The round-4 device-verdict tests ran against the <=74-entry vendored stubs;
+a real LDNOOBW list (~400 entries, ~20 distinct pattern lengths,
+c4_filters.rs:318-454) means one window-hash pass per distinct length per
+language per batch.  These tests hold verdict parity and bounded build cost
+at that scale, on generated lists with the same shape statistics
+(:mod:`textblaster_tpu.utils.synthwords`).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from textblaster_tpu.config.pipeline import parse_pipeline_config
+from textblaster_tpu.data_model import ProcessingOutcome, TextDocument
+from textblaster_tpu.filters.c4_badwords import C4BadWordsFilter
+from textblaster_tpu.ops.badwords import BadwordTables
+from textblaster_tpu.ops.pipeline import CompiledPipeline, process_documents_device
+from textblaster_tpu.orchestration import process_documents_host
+from textblaster_tpu.pipeline_builder import build_pipeline_from_config
+from textblaster_tpu.utils.synthwords import synth_badwords
+
+EN_SEED, DA_SEED, ZH_SEED = 101, 202, 303
+
+_CLEAN_VOCAB = (
+    "the quick brown fox jumps over lazy dog and runs through green fields "
+    "near river where people walk their dogs every morning before work"
+).split()
+
+
+def _mk(i, text, metadata=None, prefix="d"):
+    return TextDocument(
+        id=f"{prefix}{i}", source="t", content=text, metadata=dict(metadata or {})
+    )
+
+
+def _corpus(rng, words, n_docs, lang, embed_frac=0.5, substr_frac=0.15, prefix="d"):
+    """Docs: ~half embed a sampled pattern (boundary-separated), some embed a
+    pattern as a strict substring of a longer token (must NOT match for
+    boundary-checked languages), rest are clean."""
+    docs = []
+    for i in range(n_docs):
+        base = " ".join(
+            _CLEAN_VOCAB[int(rng.integers(0, len(_CLEAN_VOCAB)))]
+            for _ in range(int(rng.integers(6, 20)))
+        )
+        r = rng.random()
+        w = words[int(rng.integers(0, len(words)))]
+        if r < embed_frac:
+            parts = base.split()
+            k = int(rng.integers(0, len(parts) + 1))
+            base = " ".join(parts[:k] + [w] + parts[k:])
+        elif r < embed_frac + substr_frac:
+            base = base + " pre" + w.replace(" ", "") + "fix"
+        docs.append(_mk(i, base, {"language": lang}, prefix=prefix))
+    return docs
+
+
+def test_fullscale_list_shape():
+    words = synth_badwords(EN_SEED, n=400)
+    assert len(words) == 400
+    lengths = {len(w) for w in words}
+    assert len(lengths) >= 15, sorted(lengths)
+    assert any(" " in w for w in words)  # multi-word phrases present
+    t0 = time.perf_counter()
+    tables = BadwordTables.build(words, check_boundaries=True)
+    build_s = time.perf_counter() - t0
+    assert tables is not None
+    assert build_s < 1.0, f"table build took {build_s:.2f}s for 400 entries"
+    assert tables.max_dup <= 2  # h1 collisions within a length stay rare
+    assert len(tables.lengths) == len(lengths)
+
+
+def test_fullscale_en_device_parity(tmp_path, monkeypatch):
+    words = synth_badwords(EN_SEED, n=400)
+    (tmp_path / "en").write_text("\n".join(words) + "\n", encoding="utf-8")
+    config = parse_pipeline_config(
+        """
+pipeline:
+  - type: C4BadWordsFilter
+    default_language: en
+    keep_fraction: 0.0
+    fail_on_missing_language: true
+"""
+    )
+    config.pipeline[0].params.cache_base_path = tmp_path
+
+    rng = np.random.default_rng(7)
+    docs = _corpus(rng, words, 96, "en")
+    docs_h = [d.copy() for d in docs]
+
+    executor = build_pipeline_from_config(config)
+    host = {o.document.id: o for o in process_documents_host(executor, iter(docs_h))}
+    kinds = {o.kind for o in host.values()}
+    assert kinds == {ProcessingOutcome.SUCCESS, ProcessingOutcome.FILTERED}
+
+    def _boom(self, document):
+        raise AssertionError("host regex filter ran for a compiled language")
+
+    monkeypatch.setattr(C4BadWordsFilter, "process", _boom)
+    dev = {
+        o.document.id: o
+        for o in process_documents_device(config, iter([d.copy() for d in docs]))
+    }
+    assert set(host) == set(dev)
+    for k in host:
+        assert host[k].kind == dev[k].kind, k
+        assert host[k].reason == dev[k].reason, k
+
+
+def test_fullscale_multilang_incl_cjk(tmp_path, monkeypatch):
+    """>=3 languages through _badwords_all_tables, one CJK, all full-scale,
+    every doc decided on device."""
+    en = synth_badwords(EN_SEED, n=400)
+    da = synth_badwords(DA_SEED, n=150)
+    zh = synth_badwords(ZH_SEED, n=300, cjk=True)
+    (tmp_path / "en").write_text("\n".join(en) + "\n", encoding="utf-8")
+    (tmp_path / "da").write_text("\n".join(da) + "\n", encoding="utf-8")
+    (tmp_path / "zh").write_text("\n".join(zh) + "\n", encoding="utf-8")
+    config = parse_pipeline_config(
+        """
+pipeline:
+  - type: C4BadWordsFilter
+    default_language: en
+    keep_fraction: 0.0
+    fail_on_missing_language: true
+"""
+    )
+    config.pipeline[0].params.cache_base_path = tmp_path
+
+    rng = np.random.default_rng(11)
+    docs = (
+        _corpus(rng, en, 40, "en", prefix="en")
+        + [
+            TextDocument(
+                id=f"da{i}", source="t", content=c, metadata={"language": "da"}
+            )
+            for i, c in enumerate(
+                d.content for d in _corpus(rng, da, 24, "da")
+            )
+        ]
+        + [
+            TextDocument(
+                id=f"zh{i}",
+                source="t",
+                # CJK: unanchored — embedded substrings must match.
+                content=(
+                    "".join(
+                        chr(int(c))
+                        for c in rng.integers(0x4E00, 0x9FA5, size=20)
+                    )
+                    + (zh[int(rng.integers(0, len(zh)))] if rng.random() < 0.5 else "")
+                    + "".join(
+                        chr(int(c))
+                        for c in rng.integers(0x4E00, 0x9FA5, size=12)
+                    )
+                ),
+                metadata={"language": "zh"},
+            )
+            for i in range(32)
+        ]
+    )
+    docs_h = [d.copy() for d in docs]
+    executor = build_pipeline_from_config(config)
+    host = {o.document.id: o for o in process_documents_host(executor, iter(docs_h))}
+    # Every language class produced both verdicts somewhere in the corpus.
+    for prefix in ("en", "da", "zh"):
+        ks = {o.kind for i, o in host.items() if i.startswith(prefix)}
+        assert ProcessingOutcome.FILTERED in ks, prefix
+
+    def _boom(self, document):
+        raise AssertionError("host regex filter ran for a compiled language")
+
+    monkeypatch.setattr(C4BadWordsFilter, "process", _boom)
+    dev = {
+        o.document.id: o
+        for o in process_documents_device(config, iter([d.copy() for d in docs]))
+    }
+    assert set(host) == set(dev)
+    for k in host:
+        assert host[k].kind == dev[k].kind, k
+        assert host[k].reason == dev[k].reason, k
+
+
+def test_fullscale_compiled_pipeline_bounded(tmp_path):
+    """The [B, L] batch kernel against a 400-entry table compiles and runs in
+    bounded time on the test backend (the TPU cost is measured by the bench's
+    badwords config, not here)."""
+    words = synth_badwords(EN_SEED, n=400)
+    (tmp_path / "en").write_text("\n".join(words) + "\n", encoding="utf-8")
+    config = parse_pipeline_config(
+        """
+pipeline:
+  - type: C4BadWordsFilter
+    default_language: en
+    keep_fraction: 0.0
+    fail_on_missing_language: true
+"""
+    )
+    config.pipeline[0].params.cache_base_path = tmp_path
+    pipeline = CompiledPipeline(config, batch_size=32, buckets=(512,))
+    assert pipeline.device_steps and not pipeline.host_steps
+    rng = np.random.default_rng(3)
+    docs = _corpus(rng, words, 64, "en")
+    t0 = time.perf_counter()
+    out = list(process_documents_device(config, iter(docs), pipeline=pipeline))
+    elapsed = time.perf_counter() - t0
+    assert len(out) == 64
+    assert elapsed < 120, f"full-scale badwords batch took {elapsed:.1f}s"

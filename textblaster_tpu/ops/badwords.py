@@ -19,10 +19,23 @@ negligible-collision class the duplicate tables already document
 (:mod:`.stats`).  The host therefore trusts the device verdict: non-matching
 documents never touch the host regex, and matching documents only draw the
 seeded keep-fraction (VERDICT r3 item 6).
+
+Case-folding exactness (ADVICE r4): ``re.IGNORECASE`` equates a handful of
+codepoint pairs that single-char lowercasing cannot (``ſ``/``s``, ``ı``/``i``,
+``µ``/``μ``, …: CPython's ``_equivalences`` table), and a few codepoints have
+multi-char lowers (``İ``) the device's char→char table maps to identity.
+Rather than documenting a silent false-negative class, the kernel *routes
+around it*: pattern lists containing fold-divergent codepoints disqualify
+device tables entirely (``BadwordTables.build`` → None → host regex), and
+rows containing a text-side hazard codepoint are flagged per row
+(``fold_hazard``) and re-decided by the host regex — the same escape hatch
+uncompiled languages use.  Both sets are computed from the running
+interpreter's own folding behavior, so the guarantee tracks the oracle.
 """
 
 from __future__ import annotations
 
+from functools import lru_cache
 from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
@@ -46,6 +59,130 @@ MAX_PATTERN_CPS = 48
 #: Second, independent window-hash multiplier (odd, so invertible mod 2^32).
 MUL2 = 1000003
 
+# re.IGNORECASE's extra single-char equivalences beyond str.lower (CPython
+# sre; imported from the interpreter when exposed so the set tracks the
+# oracle's actual behavior, with the full CPython-3.12 table as fallback).
+_EQUIV_FALLBACK = (
+    (0x69, 0x131),            # i, dotless i
+    (0x73, 0x17F),            # s, long s
+    (0xB5, 0x3BC),            # micro sign, greek mu
+    (0x345, 0x3B9, 0x1FBE),   # ypogegrammeni, iota, prosgegrammeni
+    (0x390, 0x1FD3),          # iota dialytika+tonos / +oxia
+    (0x3B0, 0x1FE3),          # upsilon dialytika+tonos / +oxia
+    (0x3B2, 0x3D0),           # beta / beta symbol
+    (0x3B5, 0x3F5),           # epsilon / lunate epsilon
+    (0x3B8, 0x3D1),           # theta / theta symbol
+    (0x3BA, 0x3F0),           # kappa / kappa symbol
+    (0x3C0, 0x3D6),           # pi / omega pi
+    (0x3C1, 0x3F1),           # rho / rho symbol
+    (0x3C2, 0x3C3),           # final sigma / sigma
+    (0x3C6, 0x3D5),           # phi / phi symbol
+    (0x432, 0x1C80),          # cyrillic ve / rounded ve
+    (0x434, 0x1C81),          # cyrillic de / long-legged de
+    (0x43E, 0x1C82),          # cyrillic o / narrow o
+    (0x441, 0x1C83),          # cyrillic es / wide es
+    (0x442, 0x1C84, 0x1C85),  # cyrillic te / tall te / three-legged te
+    (0x44A, 0x1C86),          # cyrillic hard sign / tall hard sign
+    (0x463, 0x1C87),          # cyrillic yat / tall yat
+    (0x1C88, 0xA64B),         # cyrillic unblended uk / monograph uk
+    (0x1E61, 0x1E9B),         # s with dot above / long s with dot above
+    (0xFB05, 0xFB06),         # latin small ligature st variants
+)
+
+
+def _equivalence_classes():
+    # 3.12+: re._casefix._EXTRA_CASES (cp -> equivalent lowered cps; 50
+    # entries incl. Greek variant letters and final sigma).  Older: the
+    # _equivalences tuple in the sre compiler.  Both are the exact tables
+    # the running re module matches with.
+    try:
+        from re import _casefix  # type: ignore[attr-defined]
+
+        return tuple(
+            (k, *v) for k, v in sorted(_casefix._EXTRA_CASES.items())
+        )
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from re._compiler import _equivalences  # type: ignore[attr-defined]
+
+        return tuple(_equivalences)
+    except Exception:  # noqa: BLE001
+        try:
+            from sre_compile import _equivalences  # type: ignore[attr-defined]
+
+            return tuple(_equivalences)
+        except Exception:  # noqa: BLE001
+            return _EQUIV_FALLBACK
+
+
+def _table_lower(cp: int) -> int:
+    """The device lower table's mapping (identity for multi-char lowers)."""
+    low = chr(cp).lower()
+    return ord(low) if len(low) == 1 else cp
+
+
+@lru_cache(maxsize=1)
+def _fold_partners() -> Tuple[Dict[int, Tuple[int, ...]], frozenset]:
+    """(partner map in table-lower space, the "common" codepoints).
+
+    The device lowers text through the char→char table (:func:`lower_table`);
+    ``re.IGNORECASE`` lowers both sides AND applies the sre equivalence
+    classes.  A device miss therefore needs a *pair*: a pattern codepoint and
+    a text codepoint the regex folds together but the table lowers
+    differently.  ``partners[x]`` lists the table-lower-space codepoints the
+    regex equates with ``x`` despite distinct table lowers — built from the
+    equivalence classes plus the multi-char-lower codepoints (``İ`` is a
+    table identity but regex-equal to ``i``).
+
+    "Common" codepoints are ASCII or have an uppercase pre-image under
+    single-char lower (``σ`` ← ``Σ``); their partner rows cannot be
+    hazard-flagged without forfeiting the fast path for ordinary text, so a
+    pattern whose divergence partner is common disqualifies its whole list
+    instead (``ſtop`` would need every ``s`` row host-routed).  Rare partners
+    (``ſ``, ``ı``, ``İ``, the historic Cyrillic letterforms) are cheap to
+    flag per-row, so lists whose divergences are all rare-sided stay
+    device-compiled with a per-list hazard set (``BadwordTables.hazard_cps``).
+    """
+    from ..utils import chartables as ct
+
+    max_cp = ct._MAX_CP
+    partners: Dict[int, set] = {}
+
+    def _link(a: int, b: int) -> None:
+        la, lb = _table_lower(a), _table_lower(b)
+        if la != lb and la < max_cp and lb < max_cp:
+            partners.setdefault(la, set()).add(lb)
+            partners.setdefault(lb, set()).add(la)
+
+    for cls in _equivalence_classes():
+        for i, a in enumerate(cls):
+            for b in cls[i + 1 :]:
+                _link(a, b)
+    # Multi-char lowers: regex folds them via simple per-char tolower (first
+    # char of the full lower); the table keeps them as identities.
+    for cp in range(max_cp):
+        low = chr(cp).lower()
+        if len(low) != 1:
+            _link(cp, ord(low[0]))
+
+    # Common = ASCII, or some *other* codepoint single-char-lowers to it
+    # (i.e. it has an uppercase form in ordinary text).
+    has_preimage = np.zeros(max_cp, dtype=bool)
+    for cp in range(max_cp):
+        lcp = _table_lower(cp)
+        if lcp != cp and lcp < max_cp:
+            has_preimage[lcp] = True
+    common = frozenset(
+        x
+        for x in {p for v in partners.values() for p in v} | set(partners)
+        if x < 0x80 or has_preimage[x]
+    )
+    return (
+        {k: tuple(sorted(v)) for k, v in partners.items()},
+        common,
+    )
+
 
 def _hash_cps(cps: Sequence[int], mul: int) -> int:
     """Host twin of the device window hash (int32 wraparound)."""
@@ -68,14 +205,30 @@ class BadwordTables(NamedTuple):
     tables2: Tuple[np.ndarray, ...]  # int32 h2, aligned with tables1
     max_dup: int  # most patterns sharing one h1 within a length
     check_boundaries: bool  # False for CJK languages (ja/th/zh)
+    #: Table-lower-space codepoints whose presence in a TEXT row voids the
+    #: device verdict for this list (IGNORECASE folds them into a pattern
+    #: codepoint the char→char table cannot — see _fold_partners).
+    hazard_cps: Tuple[int, ...] = ()
 
     @classmethod
     def build(
         cls, words: Sequence[str], check_boundaries: bool
     ) -> Optional["BadwordTables"]:
-        """None if any pattern is empty/too long (caller falls back to host)."""
+        """None if any pattern is empty/too long, contains a codepoint whose
+        lowercase is multi-char (hash length would diverge from the table's),
+        or fold-diverges against a *common* text codepoint (caller falls back
+        to host — see module docstring)."""
+        partners, fold_common = _fold_partners()
+        hazard: set = set()
         by_len: Dict[int, List[Tuple[int, int]]] = {}
         for w in words:
+            if any(len(c.lower()) != 1 for c in w):
+                return None
+            for c in w.lower():
+                for p in partners.get(_table_lower(ord(c)), ()):
+                    if p in fold_common:
+                        return None
+                    hazard.add(p)
             cps = [ord(c) for c in w.lower()]
             if not cps or len(cps) > MAX_PATTERN_CPS:
                 return None
@@ -101,6 +254,7 @@ class BadwordTables(NamedTuple):
             tables2=tuple(t2s),
             max_dup=max_dup,
             check_boundaries=check_boundaries,
+            hazard_cps=tuple(sorted(hazard)),
         )
 
 
@@ -131,6 +285,8 @@ def _window_context(cps: jax.Array, lengths: jax.Array) -> dict:
 
     wordch = ((classify(low) & ALNUM) != 0) | (low == ord("_"))
     return {
+        "low": low,
+        "mask": mask,
         "pos": pos,
         "lengths": lengths,
         "h1": h1,
@@ -169,21 +325,56 @@ def _match_with_context(ctx: dict, tables: BadwordTables) -> jax.Array:
     return match
 
 
+def _hazard_rows(ctx: dict, hazard_cps) -> jax.Array:
+    """``[B] bool`` — rows containing any of the (few) hazard codepoints, in
+    table-lower space.  Empty hazard sets (the common case: e.g. no pattern
+    uses ``s``'s partner ``ſ`` unless some pattern contains ``s`` — which
+    English lists do, giving {ſ}) compile to a constant False."""
+    hz = jnp.zeros(ctx["n_rows"], dtype=bool)
+    for cp in hazard_cps:
+        hz = hz | jnp.any((ctx["low"] == jnp.int32(cp)) & ctx["mask"], axis=1)
+    return hz
+
+
 def badwords_matches(
     cps: jax.Array, lengths: jax.Array, tables: BadwordTables
-) -> jax.Array:
-    """``[B] bool`` — the regex-match verdict per document (see module
-    docstring for the 2^-64 collision caveat)."""
-    return _match_with_context(_window_context(cps, lengths), tables)
+) -> Tuple[jax.Array, jax.Array]:
+    """``([B] bool match, [B] bool fold_hazard)`` per document.
+
+    The match verdict equals the reference regex's on every row whose hazard
+    flag is False (module docstring: 2^-64 collision caveat).  Hazard rows
+    contain a codepoint IGNORECASE folds into a pattern codepoint the
+    char→char lower table cannot — the caller must re-decide those rows with
+    the host regex."""
+    ctx = _window_context(cps, lengths)
+    return _match_with_context(ctx, tables), _hazard_rows(ctx, tables.hazard_cps)
 
 
 def badwords_matches_multi(
     cps: jax.Array, lengths: jax.Array, tables_by_lang: dict
-) -> dict:
-    """Match verdicts for several languages' tables, sharing the hash scans
-    (the scans dominate; per-language window tests are cheap)."""
+) -> Tuple[dict, dict]:
+    """(per-language match verdicts, per-language ``[B] bool`` hazard rows).
+
+    Verdicts for several languages' tables share the hash scans (the scans
+    dominate; per-language window tests are cheap).  A hazard row contains a
+    codepoint whose IGNORECASE folding the char→char lower table cannot
+    express *for that language's pattern list*; its verdict must come from
+    the host regex (module docstring)."""
     ctx = _window_context(cps, lengths)
-    return {
+    per_lang = {
         lang: _match_with_context(ctx, tables)
         for lang, tables in sorted(tables_by_lang.items())
     }
+    # Hazards are per-language: a row quoting historic Cyrillic must only be
+    # host-routed when decided AGAINST a list whose patterns fold into those
+    # codepoints, not because some other language's table is loaded.
+    # Identical hazard sets share one computed array (common case: every
+    # Latin list hazards exactly {ſ, ı, İ}).
+    by_set: Dict[Tuple[int, ...], jax.Array] = {}
+    hazards = {}
+    for lang, tables in sorted(tables_by_lang.items()):
+        key = tuple(tables.hazard_cps)
+        if key not in by_set:
+            by_set[key] = _hazard_rows(ctx, key)
+        hazards[lang] = by_set[key]
+    return per_lang, hazards

@@ -184,6 +184,7 @@ class _StepEval:
         "c4_rewrite_identity",
         "badwords_matches",
         "badwords_default_language",
+        "badwords_fold_hazard",
     )
 
     def __init__(self, passed, decide, pass_stamps, overflow=None):
@@ -198,6 +199,7 @@ class _StepEval:
         self.c4_rewrite_identity = None
         self.badwords_matches = None
         self.badwords_default_language = None
+        self.badwords_fold_hazard = None
 
 
 # Step types that cheaply kill many documents: a phase boundary after them
@@ -435,10 +437,12 @@ class CompiledPipeline:
                     for k, v in fw.items():
                         out[f"{i}:{k}"] = v
                 elif kind == "badwords":
-                    for lang, m in badwords_matches_multi(
+                    per_lang, per_hazard = badwords_matches_multi(
                         state["cps"], state["lengths"], arg
-                    ).items():
+                    )
+                    for lang, m in per_lang.items():
                         out[f"{i}:match:{lang}"] = m
+                        out[f"{i}:hazard:{lang}"] = per_hazard[lang]
             return out
 
         if self.mesh is not None:
@@ -499,8 +503,23 @@ class CompiledPipeline:
                 jobs.append((key, fn.lower(cps, lens)))
 
         def compile_one(item):
+            # The remote-tunnel compile service drops connections under load
+            # ("response body closed before all bytes were read" killed the
+            # first round-5 TPU bench run outright).  A transient transport
+            # failure must cost a retry, not the benchmark: back off and
+            # re-issue the compile; the lowered IR is reusable.  Genuine
+            # compile errors (shape/VMEM) repeat identically and surface on
+            # the final attempt.
             key, lowered = item
-            return key, lowered.compile()
+            last = None
+            for attempt in range(4):
+                try:
+                    return key, lowered.compile()
+                except Exception as e:  # noqa: BLE001
+                    last = e
+                    if attempt < 3:
+                        _time.sleep(2.0 * (attempt + 1))
+            raise last
 
         with ThreadPoolExecutor(max_workers=max_workers) as pool:
             for key, compiled in pool.map(compile_one, jobs):
@@ -852,6 +871,10 @@ class CompiledPipeline:
             lang: np.asarray(stats[f"{idx}:match:{lang}"], dtype=bool)
             for lang in self._badwords_device_tables.get(idx, {})
         }
+        hazards = {
+            lang: np.asarray(stats[f"{idx}:hazard:{lang}"], dtype=bool)
+            for lang in self._badwords_device_tables.get(idx, {})
+        }
 
         def decide(row: int, doc: TextDocument) -> _Decision:
             # The device kernel delivers the regex-match verdict for every
@@ -867,7 +890,10 @@ class CompiledPipeline:
             host_step = self._badwords_host_step(idx)
             doc_lang = doc.metadata.get("language", p.default_language)
             m = matches.get(doc_lang)
-            if m is None:
+            if m is None or hazards[doc_lang][row]:
+                # Uncompiled language, or the row contains a codepoint whose
+                # IGNORECASE folding this language's table cannot express
+                # (ops/badwords.py module docstring) — the host regex decides.
                 try:
                     host_step.process(doc)  # stamps metadata itself
                 except DocumentFiltered as e:
@@ -893,6 +919,7 @@ class CompiledPipeline:
         ev = _StepEval(passed=None, decide=decide, pass_stamps=None)
         ev.badwords_matches = matches
         ev.badwords_default_language = p.default_language
+        ev.badwords_fold_hazard = hazards
         return ev
 
     def _eval_fineweb(self, step: StepConfig, idx: int, stats) -> "_StepEval":
@@ -1163,7 +1190,11 @@ class CompiledPipeline:
                 # and uncompiled languages go through decide().
                 doc_lang = doc.metadata.get("language", ev.badwords_default_language)
                 m = ev.badwords_matches.get(doc_lang)
-                if m is not None and not m[row]:
+                if (
+                    m is not None
+                    and not m[row]
+                    and not ev.badwords_fold_hazard[doc_lang][row]
+                ):
                     for k, v in self._BADWORDS_PASS_STAMPS:
                         doc.metadata[k] = v
                     continue

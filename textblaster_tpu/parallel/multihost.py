@@ -249,6 +249,15 @@ def run_multihost(
 
     Returns an ``AggregationResult``: global totals on process 0 (after the
     merge), local totals elsewhere.
+
+    Failure behavior (measured, tests/test_multihost.py): if a process dies
+    mid-run, survivors do NOT hang on the next allgather — the jax
+    coordination service detects the missed heartbeats (~90 s) and
+    propagates UNAVAILABLE to every healthy task, which exits nonzero with
+    the dead task named in the error.  The run is then re-launched whole;
+    per-process restart-in-place is not supported (matches the reference's
+    worker model, where a dead worker's unacked queue messages are simply
+    redelivered to a fresh worker).
     """
     import os
     from itertools import islice
