@@ -32,6 +32,7 @@ import os
 import subprocess
 import sys
 import time
+from typing import Optional
 
 import numpy as np
 
@@ -50,19 +51,27 @@ SEED = 20260729
 # CPU A/B: 33.1 -> 39.7 docs/s; see TPU_EVIDENCE_r04.md for the stricter
 # full-corpus-oracle record).
 LONGDOC_N_DOCS = 512
-LONGDOC_BUCKETS = (8192, 16384, 32768)
+# Scan-bound at padded width: the finer ladder cut padded compute from
+# 1.48x to 1.21x of real chars and took the CPU record from 0.90x to 1.11x
+# the oracle (partial batches cost little at 8-row batches).
+LONGDOC_BUCKETS = (4096, 8192, 12288, 16384, 24576, 32768)
 
-# Device batch rows.  Large batches amortize the remote tunnel's per-dispatch
-# round trip (~66ms) and upload latency (~65 MB/s measured); 1024 rows of the
-# largest (2048-char) bucket is an 8 MB upload per dispatch.
-def _device_batch() -> int:
+# Device batch rows.  BENCH_BATCH overrides; otherwise the platform-aware
+# default from ops.pipeline.default_batch_size applies (TPU: large batches
+# amortize the tunnel's ~66ms round trip; XLA:CPU: small batches keep the
+# per-op working set L2-resident — the measured knee that flipped every
+# sub-1.0 CPU config above the oracle).
+def _device_batch() -> Optional[int]:
+    raw = os.environ.get("BENCH_BATCH")
+    if not raw:
+        return None  # CompiledPipeline resolves the platform default
     try:
-        n = int(os.environ.get("BENCH_BATCH", "1024"))
+        n = int(raw)
     except ValueError:
         n = 0
     if n < 8:
-        _log("bad BENCH_BATCH; using 1024")
-        return 1024
+        _log("bad BENCH_BATCH; using platform default")
+        return None
     return n
 
 
@@ -519,12 +528,16 @@ def main() -> int:
     executor = build_pipeline_from_config(config)
     load_before_oracle = os.getloadavg()[0]
     oracle_pass_s = []
+    oracle_cpu_frac = []  # process_time/wall per pass: <1 => core was shared
     for _ in range(3):
         _touch_lock()  # keep the prober's 30-min freshness window alive
         sample = [d.copy() for d in docs[:cpu_sample]]
         t0 = time.perf_counter()
+        c0 = time.process_time()
         host_outcomes = list(process_documents_host(executor, iter(sample)))
-        oracle_pass_s.append(round(time.perf_counter() - t0, 3))
+        wall = time.perf_counter() - t0
+        oracle_pass_s.append(round(wall, 3))
+        oracle_cpu_frac.append(round((time.process_time() - c0) / wall, 3))
     load_after_oracle = os.getloadavg()[0]
     cpu_elapsed = min(oracle_pass_s)
     cpu_rate = len(sample) / cpu_elapsed
@@ -573,12 +586,11 @@ def main() -> int:
     # serves both, so the timed run executes already-warmed programs and
     # never bills a compile or an executable (re)load to the measurement.
     _log(f"device backend: {jax.default_backend()}")
+    bench_buckets = buckets_for_platform(platform, bench_name)
     device_batch = _device_batch()
-    if bench_name == "longdoc" and not os.environ.get("BENCH_BATCH"):
-        device_batch = 64  # 64 rows x 32k chars = 8 MB/dispatch, same as full
     pipeline = CompiledPipeline(
         config,
-        buckets=buckets_for_platform(platform, bench_name),
+        buckets=bench_buckets,
         batch_size=device_batch,
     )
     # Concurrent AOT compile of every (bucket, phase) program, then a
@@ -600,14 +612,18 @@ def main() -> int:
     tails_before = METRICS.get("worker_host_tail_total")
     load_before_dev = os.getloadavg()[0]
     device_pass_s = []
+    device_cpu_frac = []  # meaningful on the cpu platform; low on TPU (waits)
     for _ in range(3):
         _touch_lock()  # long cold warmups can outlive the freshness window
         run_docs = [d.copy() for d in docs]
         t0 = time.perf_counter()
+        c0 = time.process_time()
         dev_outcomes = list(
             process_documents_device(config, iter(run_docs), pipeline=pipeline)
         )
-        device_pass_s.append(round(time.perf_counter() - t0, 3))
+        wall = time.perf_counter() - t0
+        device_pass_s.append(round(wall, 3))
+        device_cpu_frac.append(round((time.process_time() - c0) / wall, 3))
     load_after_dev = os.getloadavg()[0]
     dev_elapsed = min(device_pass_s)
     dev_rate = len(run_docs) / dev_elapsed
@@ -661,10 +677,15 @@ def main() -> int:
     oracle_spread = round((max(oracle_pass_s) - cpu_elapsed) / cpu_elapsed, 3)
     device_spread = round((max(device_pass_s) - dev_elapsed) / dev_elapsed, 3)
     noise_flags = []
-    if max(load_before_oracle, load_after_oracle) > 1.8:
-        noise_flags.append("oracle_load_high")
-    if max(load_before_dev, load_after_dev) > 1.8:
-        noise_flags.append("device_load_high")
+    # process_time/wall is the direct core-sharing signal: the oracle is
+    # pure in-process CPU work, so a best pass below ~0.75 means a foreign
+    # process held the core during it.  (Load averages carry a false
+    # positive: the 8-thread AOT warmup's 1-min tail overlaps the first
+    # device passes; they are still recorded below for context.)
+    if max(oracle_cpu_frac) < 0.75:
+        noise_flags.append("oracle_core_shared")
+    if jax.default_backend() == "cpu" and max(device_cpu_frac) < 0.75:
+        noise_flags.append("device_core_shared")
     if oracle_spread > 0.2:
         noise_flags.append("oracle_spread_high")
     if device_spread > 0.2:
@@ -677,6 +698,8 @@ def main() -> int:
         "vs_baseline": round(dev_rate / cpu_rate, 3),
         "oracle_pass_s": oracle_pass_s,
         "device_pass_s": device_pass_s,
+        "oracle_cpu_frac": oracle_cpu_frac,
+        "device_cpu_frac": device_cpu_frac,
         "oracle_spread": oracle_spread,
         "device_spread": device_spread,
         "load_1m": {
@@ -697,6 +720,8 @@ def main() -> int:
         "decision_parity": round(parity, 6),
         "parity_denominator": len(host_by_id),
         "n_docs": len(run_docs),
+        "device_batch": pipeline.batch_size,
+        "buckets": list(pipeline.buckets),
         "platform": jax.default_backend(),
         "warmup_s": round(warmup_s, 1),
         "warmup_compile_s": round(compile_s, 1),

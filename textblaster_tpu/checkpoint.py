@@ -270,7 +270,7 @@ def run_checkpointed(
             mesh = data_mesh()  # same sharding as the non-checkpointed runner
         pkw = {} if buckets is None else {"buckets": buckets}
         pipeline = CompiledPipeline(
-            config, batch_size=device_batch or 256, mesh=mesh, **pkw
+            config, batch_size=device_batch, mesh=mesh, **pkw
         )
 
         def process_chunk(items) -> Iterator[ProcessingOutcome]:

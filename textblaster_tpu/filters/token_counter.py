@@ -40,6 +40,11 @@ class TokenCounter(ProcessingStep):
     def __init__(self, tokenizer_name: str) -> None:
         self._tokenizer = None
         self._bpe = None
+        #: True when the in-repo-trained stand-in replaced an unreachable hub
+        #: tokenizer: counts then differ from the reference's, and every
+        #: document is stamped so divergent runs are identifiable
+        #: (ADVICE r4).
+        self._standin = False
         try:
             json_path = tokenizer_name
             merges_path = None
@@ -84,6 +89,10 @@ class TokenCounter(ProcessingStep):
                         vendored,
                     )
                     self._tokenizer = Tokenizer.from_file(vendored)
+                    self._standin = True
+                    from ..utils.metrics import METRICS
+
+                    METRICS.inc("worker_tokenizer_standin_total")
         except Exception as e:
             raise UnexpectedError("Error in loading tokenizer") from e
 
@@ -99,4 +108,8 @@ class TokenCounter(ProcessingStep):
         except Exception as e:
             raise UnexpectedError(str(e)) from e
         document.metadata["token_count"] = str(count)
+        if self._standin:
+            # Not a reference metadata key: deliberately extra so downstream
+            # consumers can tell stand-in counts from hub-gpt2 counts.
+            document.metadata["token_count_tokenizer"] = "vendored-standin"
         return document

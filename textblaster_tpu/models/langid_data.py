@@ -555,7 +555,12 @@ sp√∏rgsm√•l svar l√∏sning l√∏sninger forskning forskningen udgift udgifter indt√
 n√¶ste stor store st√∏rre st√∏rst lille sm√• mindre mindst god bedre bedst
 dreng pige mand kvinde barn b√∏rn menneske mennesker ven venner
 sundhedsv√¶sen sundhedsv√¶senet hovedstaden udlandet indbygger indbyggere
-anmeldelse anmeldelser biograf biografen biograferne avis avisen aviser""",
+anmeldelse anmeldelser biograf biografen biograferne avis avisen aviser
+afpr√∏ver afpr√∏vede hj√¶lpe hj√¶lp hj√¶lpen k√∏en skolerne bylinjerne
+regnskovene fr√∏art borgmester borgmesteren bekymrede for√•ret
+bedstefar bedstefaren bedstemor hendes hende tilladelse tilladelsen havnen
+imponerende pr√¶cision spillede strikkede f√∏dselsdag hejste stormvarslet
+middagstid dyrkede ryddede m√•gerne kredsede krydser billetpriserne""",
     "Bokmal": """av ut opp inn ned bort hva hvor hvordan hvorfor n√•r ikke etter siste f√∏rst
 mellom gjennom uten innen innenfor utenfor omkring kanskje allerede alltid aldri
 arbeid arbeidet arbeider utvikling utviklingen utstilling utstillingen utdanning utdanningen
@@ -571,7 +576,13 @@ sp√∏rsm√•l svar l√∏sning l√∏sninger forskning forskningen utgift utgifter inntek
 neste stor store st√∏rre st√∏rst liten sm√• mindre minst god bedre best
 gutt jente mann kvinne barn mennesker venn venner
 helsevesen helsevesenet hovedstaden utlandet innbygger innbyggere
-anmeldelse anmeldelser kino kinoen avis avisen aviser""",
+anmeldelse anmeldelser kino kinoen avis avisen aviser
+ordf√∏rer ordf√∏reren lovet kollektivtransport v√•ren b√∏ndene bekymret
+bestefar bestefaren bestemor bestemoren hennes henne
+pr√∏veprosjekt tillatelse tillatelsen havna dyrket vika
+fylke fylket fylkeskommunen nabolaget framtiden fremtiden
+imponerende ryddet handlet m√•kene kretset krysser billettprisene
+turstien kanelboller prisene""",
     "Nynorsk": """av ut opp inn ned bort kva kvar korleis kvifor n√•r ikkje etter siste f√∏rst
 mellom gjennom utan innan innanfor utanfor omkring kanskje allereie alltid aldri
 arbeid arbeidet arbeider utvikling utviklinga utstilling utstillinga utdanning utdanninga
@@ -587,7 +598,13 @@ sp√∏rsm√•l svar l√∏ysing l√∏ysingar forsking forskinga utgift utgifter inntekt i
 neste stor store st√∏rre st√∏rst liten sm√• mindre minst god betre best
 gut jente mann kvinne barn born menneske menneska venn venner
 helsevesen helsevesenet hovudstaden utlandet innbyggjar innbyggjarar
-melding meldingar kino kinoen avis avisa aviser""",
+melding meldingar kino kinoen avis avisa aviser
+ordf√∏rar ordf√∏raren lova uroa manglande rimelege bustad bustader
+fleire imponerande presisjonen framf√∏rt hennar honom
+fylkeskommunen framtida kvelden l√∏yve l√∏yvet hamna
+trass dyrka vika pr√∏veprosjektet tusenvis
+no att d√• gav dottera sonen straum rydda letta kutta dekte
+h√∏yringa frontruta tolvtida ete drog""",
     "Swedish": """av ut upp in ner bort vad var hur varf√∂r n√§r inte efter sista f√∂rst
 mellan genom utan inom innanf√∂r utanf√∂r omkring kanske redan alltid aldrig
 arbete arbetet arbetar utveckling utvecklingen utst√§llning utst√§llningen utbildning utbildningen
@@ -603,7 +620,10 @@ fr√•ga fr√•gor svar l√∂sning l√∂sningar forskning forskningen utgift utgifter in
 n√§sta stor stora st√∂rre st√∂rst liten sm√• mindre minst god b√§ttre b√§st
 pojke flicka man kvinna barn m√§nniska m√§nniskor v√§n v√§nner
 sjukv√•rd sjukv√•rden huvudstaden utlandet inv√•nare
-recension recensioner bio bion biograf tidning tidningen tidningar""",
+recension recensioner bio bion biograf tidning tidningen tidningar
+testar hj√§lpa hj√§lp hj√§lpen sm√§rta kronisk kroniska forskare
+borgm√§stare borgm√§staren oroliga v√•ren nederb√∂rd nederb√∂rden b√∂nderna
+farfar morfar hennes henne tillst√•nd tillst√•ndet hamnen √§ntligen""",
     "English": """of out up in down away what where how why when not after last first
 between through without inside outside around maybe already always never
 work worked working development exhibition education examination

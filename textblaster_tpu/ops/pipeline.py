@@ -202,11 +202,41 @@ class _StepEval:
         self.badwords_fold_hazard = None
 
 
+def default_batch_size(buckets=DEFAULT_BUCKETS) -> int:
+    """Rows per device batch when the caller didn't choose.
+
+    XLA:CPU throughput is cache-residency-bound: per-op working sets beyond
+    the L2 fall to memory bandwidth, and the measured knee on the bench box
+    is ~128k int32 lanes per batch — dropping the full-pipeline batch from
+    1024 to 64 rows at 2048-char buckets took a pass from 6.5 s to 3.3 s
+    (oracle 6.0 s), flipping every sub-1.0 bench config above the oracle.
+    Accelerators amortize the per-dispatch cost (the remote TPU tunnel's
+    ~66 ms round trip especially) and keep the round-1024 heuristic, scaled
+    down for very wide buckets so a batch stays ~8 MB.
+    """
+    max_bucket = max(buckets)
+    if jax.default_backend() == "cpu":
+        return max(8, min(256, (64 * 2048) // max_bucket))
+    return max(64, min(1024, (1024 * 2048) // max_bucket))
+
+
 # Step types that cheaply kill many documents: a phase boundary after them
 # lets the runner repack survivors and skip the expensive downstream kernels
 # for already-filtered rows — the device analogue of the host executor's
 # short-circuit (executor.rs:30-57).
 _PHASE_BOUNDARY_AFTER = frozenset({"LanguageDetectionFilter", "GopherQualityFilter"})
+
+# Steps whose decisions depend on word segmentation (word counts, stop
+# words, word n-gram tables, words-per-line) — the steps that force
+# dictionary-script documents onto the host oracle (see __init__).
+_WORD_TABLE_STEPS = frozenset(
+    {
+        "GopherRepetitionFilter",
+        "GopherQualityFilter",
+        "C4QualityFilter",
+        "FineWebQualityFilter",
+    }
+)
 
 
 def _split_phases(steps: List[StepConfig]) -> List[List[int]]:
@@ -229,13 +259,15 @@ class CompiledPipeline:
         self,
         config: PipelineConfig,
         buckets=DEFAULT_BUCKETS,
-        batch_size: int = 256,
+        batch_size: Optional[int] = None,
         mesh=None,
         phase_split: bool = True,
     ) -> None:
         self.config = config
         self.buckets = tuple(sorted(buckets))
         self.mesh = mesh
+        if batch_size is None:
+            batch_size = default_batch_size(self.buckets)
         if mesh is not None:
             n_dev = mesh.devices.size
             batch_size = max(n_dev, (batch_size // n_dev) * n_dev)
@@ -260,6 +292,17 @@ class CompiledPipeline:
         self.host_steps = steps[n_device:]
         # Host-only fallback when un-kerneled steps precede device steps.
         self.fully_host = any(_step_on_device(s) for s in self.host_steps)
+
+        # Documents containing dictionary-segmented scripts (Han/kana/Thai…)
+        # are decided by the host oracle whenever a word-table kernel is in
+        # the pipeline: the host word splitter now approximates ICU's
+        # dictionary segmentation for those scripts (utils/cjk.py), which
+        # the kernels' UAX#29-lite run-whole tables cannot express.  Routing
+        # is a correctness fallback (counts in worker_host_fallback_total),
+        # the same pattern as kernel-table overflows.
+        self._route_dict_scripts = any(
+            s.type in _WORD_TABLE_STEPS for s in self.device_steps
+        )
 
         # Multi-phase short-circuiting: always on single-controller runs
         # (including single-process meshes — one controller dispatches for
@@ -484,11 +527,25 @@ class CompiledPipeline:
 
         Tracing happens serially up front (cheap, single-core) so the pool
         only runs the GIL-releasing ``lower().compile()`` calls.
+
+        On accelerator backends each thread also fires ONE throwaway
+        execution of its freshly compiled program (zero-filled batch):
+        the first dispatch of an executable pays a load/setup cost the
+        compile does not (measured on the round-5 TPU window: c4's
+        ``warmup_s`` was 97 s against ``warmup_compile_s`` 25.6 — ~4.8 s
+        x 15 programs of first-dispatch overhead landing in the first warm
+        pass).  Doing it here overlaps those loads across the pool.  CPU
+        backends skip it: there is no remote load to hide and a full-batch
+        execution costs real pass time.
         """
         import time as _time
         from concurrent.futures import ThreadPoolExecutor
 
+        import numpy as _np
+
         import jax.numpy as jnp
+
+        warm_dispatch = self.mesh is None and jax.default_backend() != "cpu"
 
         t0 = _time.perf_counter()
         jobs = []
@@ -514,12 +571,22 @@ class CompiledPipeline:
             last = None
             for attempt in range(4):
                 try:
-                    return key, lowered.compile()
+                    compiled = lowered.compile()
+                    break
                 except Exception as e:  # noqa: BLE001
                     last = e
                     if attempt < 3:
                         _time.sleep(2.0 * (attempt + 1))
-            raise last
+            else:
+                raise last
+            if warm_dispatch:
+                length = key[0]
+                z = jnp.asarray(
+                    _np.zeros((self.batch_size, length), dtype=_np.int32)
+                )
+                zl = jnp.asarray(_np.zeros((self.batch_size,), dtype=_np.int32))
+                jax.block_until_ready(compiled(z, zl))
+            return key, compiled
 
         with ThreadPoolExecutor(max_workers=max_workers) as pool:
             for key, compiled in pool.map(compile_one, jobs):
@@ -1108,6 +1175,19 @@ class CompiledPipeline:
 
         debug = os.environ.get("TEXTBLAST_PHASE_DEBUG") == "1"
         current: List[TextDocument] = docs
+        if self._route_dict_scripts:
+            from ..utils.cjk import has_dict_script
+
+            kept: List[TextDocument] = []
+            for doc in current:
+                if has_dict_script(doc.content):
+                    METRICS.inc("worker_host_fallback_total")
+                    outcome = execute_processing_pipeline(self.host_executor, doc)
+                    if outcome is not None:
+                        yield outcome
+                else:
+                    kept.append(doc)
+            current = kept
         for phase in range(len(self.phases)):
             t0 = time.perf_counter()
             t_dispatch = t_assemble = 0.0
@@ -1254,7 +1334,7 @@ def process_documents_device(
     multiple streams (the checkpointed runner processes one chunk per call)."""
     if pipeline is None:
         pipeline = CompiledPipeline(
-            config, buckets=buckets, batch_size=device_batch or 256, mesh=mesh
+            config, buckets=buckets, batch_size=device_batch, mesh=mesh
         )
         if pipeline.device_steps and not pipeline.fully_host and jax.default_backend() in (
             "tpu",

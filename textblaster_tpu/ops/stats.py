@@ -863,11 +863,19 @@ def _dup_run_info_sorted(
         # sidx values are exactly 0..n_valid-1 (win_valid is a prefix mask),
         # so sorting (sidx, first_in_run) restores window order with slot j
         # holding window j's run id — the scatter layout, fills included.
-        first_occ = sort2(
-            jnp.where(is_real, sidx, _I32_MAX),
-            jnp.where(is_real, first_in_run, 0),
-            mesh=mesh,
-        )[1]
+        # Pad m to a power of two first (ADVICE r4): sort2's Pallas bitonic
+        # network requires it, and a non-pow2 width here silently fell back
+        # to lax.sort — correct but off the tuned VMEM path.  Pad keys are
+        # _I32_MAX, sorting to the end; the real entries occupy slots
+        # 0..n_valid-1 either way, so slicing back is exact.
+        k0 = jnp.where(is_real, sidx, _I32_MAX)
+        k1 = jnp.where(is_real, first_in_run, 0)
+        m_pow2 = 1 << (max(m - 1, 1)).bit_length()
+        if m_pow2 != m:
+            pad = ((0, 0), (0, m_pow2 - m))
+            k0 = jnp.pad(k0, pad, constant_values=_I32_MAX)
+            k1 = jnp.pad(k1, pad)
+        first_occ = sort2(k0, k1, mesh=mesh)[1][:, :m]
     else:
         first_occ = _scatter(first_in_run, sidx, is_real, m)
     return win_valid & (first_occ < idx), first_occ

@@ -164,7 +164,22 @@ def run_local_shard(
                 over.append(d)
         return by_bucket, over
 
+    if pipeline._route_dict_scripts:
+        # Dictionary-script docs take the host oracle (ops/pipeline.py
+        # __init__ note); they join the local fallback list, which runs
+        # outside the lockstep schedule and so needs no negotiation.
+        # Single pass: ``docs`` may be any iterable, and one content scan
+        # per document suffices.
+        from ..utils.cjk import has_dict_script
+
+        routed, kept = [], []
+        for d in docs:
+            (routed if has_dict_script(d.content) else kept).append(d)
+        docs = kept
+    else:
+        routed = []
     current, fallback = partition(docs)
+    fallback.extend(routed)
 
     sh2 = batch_sharding(mesh, 2)
     sh1 = batch_sharding(mesh, 1)
@@ -297,7 +312,7 @@ def run_multihost(
     from ..ops.pipeline import CompiledPipeline
 
     pipeline = CompiledPipeline(
-        config, buckets=tuple(sorted(buckets)), batch_size=device_batch or 256,
+        config, buckets=tuple(sorted(buckets)), batch_size=device_batch,
         mesh=mesh,
     )
     outcomes = run_local_shard(

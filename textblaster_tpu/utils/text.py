@@ -138,15 +138,39 @@ def _word_mask(cps: np.ndarray, cls: np.ndarray) -> np.ndarray:
     return _attach_extend(word, cls)
 
 
-def word_spans(text: str) -> List[Tuple[int, int]]:
+def word_spans(text: str, cjk_dict: bool = True) -> List[Tuple[int, int]]:
     """(start, end) codepoint spans of the word segments of ``text``.
 
     The segments returned correspond 1:1 to ``split_into_words(text)``.
     Dispatches to the native C++ core when available (identical semantics,
     asserted by tests/test_native.py); this numpy path is the source of truth.
+
+    ``cjk_dict`` (default on — the oracle semantics) re-segments runs in
+    dictionary scripts: script-transition breaks plus greedy longest-match
+    over a Han lexicon (:mod:`textblaster_tpu.utils.cjk`), approximating the
+    reference's ICU dictionary segmentation (text.rs:107).  ``False`` keeps
+    such runs whole — the device kernels' twin semantics (documents with
+    dictionary scripts are routed to the host oracle by the device pipeline,
+    so the kernels never see them).
     """
     if not text:
         return []
+    spans = _word_spans_raw(text)
+    if cjk_dict:
+        from .cjk import DICT_SCRIPT_RE, segment_span
+
+        if DICT_SCRIPT_RE.search(text) is not None:
+            resplit: List[Tuple[int, int]] = []
+            for s, e in spans:
+                if DICT_SCRIPT_RE.search(text, s, e) is not None:
+                    resplit.extend(segment_span(text, s, e))
+                else:
+                    resplit.append((s, e))
+            spans = resplit
+    return spans
+
+
+def _word_spans_raw(text: str) -> List[Tuple[int, int]]:
     cps = codepoints(text)
     cls = classify(cps)
     if _native_spans is not None:
@@ -194,9 +218,11 @@ def word_spans(text: str) -> List[Tuple[int, int]]:
     return spans
 
 
-def split_into_words(text: str) -> List[str]:
-    """Word list with reference semantics (text.rs:103-181)."""
-    return [text[s:e] for s, e in word_spans(text)]
+def split_into_words(text: str, cjk_dict: bool = True) -> List[str]:
+    """Word list with reference semantics (text.rs:103-181), including the
+    dictionary-script approximation of ICU's CJK segmentation (see
+    :func:`word_spans`)."""
+    return [text[s:e] for s, e in word_spans(text, cjk_dict=cjk_dict)]
 
 
 # Sentence segmentation -------------------------------------------------------
@@ -331,7 +357,11 @@ def ngram_dup_stats(
     Computed by the native core over one shared segmentation when available,
     else via the Python primitives.
     """
-    if _native_spans is not None:
+    from .cjk import DICT_SCRIPT_RE
+
+    # The native core segments run-whole; texts with dictionary scripts take
+    # the Python path so their word lists include the CJK re-segmentation.
+    if _native_spans is not None and DICT_SCRIPT_RE.search(text) is None:
         try:
             from ..native import available, dup_ngram_bytes, top_ngram_bytes
         except Exception:  # pragma: no cover
