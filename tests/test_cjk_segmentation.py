@@ -33,6 +33,7 @@ JA = "日本語のテキストです。ひらがなとカタカナと漢字。"
 
 
 def test_lexicon_loads():
+    pytest.importorskip("jieba")
     lex = cjk.zh_lexicon()
     # jieba ships in this image; the 2-char table is the big one.
     assert sum(len(s) for s in lex) > 100_000
@@ -50,6 +51,7 @@ def test_script_transitions_always_break():
 
 
 def test_han_run_dictionary_split():
+    pytest.importorskip("jieba")  # zh_lexicon degrades to empty sets without it
     words = split_into_words(ZH_SAMPLES[0])
     # The run is no longer a single token; real lexicon words come out.
     assert len(words) > 5
@@ -107,6 +109,7 @@ def test_word_counts_now_realistic_for_gopher():
     """The keep/drop drift VERDICT item 8 asks to demonstrate: run-whole
     word counts starved GopherQuality's min_doc_words on zh text; the
     dictionary splitter yields realistic counts."""
+    pytest.importorskip("jieba")
     text = " ".join(ZH_SAMPLES) * 2
     n_old = len([w for w in split_into_words(text, cjk_dict=False)])
     n_new = len([w for w in split_into_words(text)])

@@ -101,12 +101,17 @@ def iter_packed_batches(
     batch_size: int = 256,
     buckets: Sequence[int] = DEFAULT_BUCKETS,
     host_tail_max: int = 0,
+    route_fn=None,
 ) -> Iterator[Tuple[Optional[PackedBatch], List[TextDocument]]]:
     """Group a document stream into per-bucket batches.
 
     Yields ``(packed_batch, host_fallback_docs)`` pairs.  Documents longer
     than the largest bucket are returned in the fallback list (processed by
     the host oracle); everything else lands in the smallest bucket that fits.
+    ``route_fn(doc) -> bool`` marks additional host-oracle documents (e.g.
+    dictionary-script or astral rows, ops/pipeline.py): they join the same
+    interleaved fallback stream, so their host processing overlaps in-flight
+    device batches instead of serializing ahead of the first dispatch.
 
     End-of-stream handling: a device program computes every padded row, so
     per-bucket partial flushes waste most of their cost.  Leftovers from all
@@ -125,7 +130,7 @@ def iter_packed_batches(
 
     for doc in docs:
         n_chars = len(doc.content)
-        if n_chars > largest:
+        if n_chars > largest or (route_fn is not None and route_fn(doc)):
             overflow.append(doc)
             if len(overflow) >= 64:
                 yield None, overflow

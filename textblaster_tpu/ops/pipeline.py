@@ -27,6 +27,7 @@ from functools import lru_cache, partial
 from typing import Callable, Dict, Iterable, Iterator, List, Optional, Tuple, Union
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..config.pipeline import PipelineConfig, StepConfig
@@ -226,6 +227,22 @@ def default_batch_size(buckets=DEFAULT_BUCKETS) -> int:
 # short-circuit (executor.rs:30-57).
 _PHASE_BOUNDARY_AFTER = frozenset({"LanguageDetectionFilter", "GopherQualityFilter"})
 
+def _wire_u16() -> bool:
+    """uint16 device uploads (see CompiledPipeline.__init__ note).
+
+    ``TEXTBLAST_WIRE=u16|cp32`` pins it; the default is u16 on accelerator
+    backends (halves the dominant tunnel transfer) and cp32 on CPU (no
+    transfer to save; the widen would be pure cost)."""
+    import os
+
+    w = os.environ.get("TEXTBLAST_WIRE", "")
+    if w == "u16":
+        return True
+    if w == "cp32":
+        return False
+    return jax.default_backend() in ("tpu", "axon")
+
+
 # Steps whose decisions depend on word segmentation (word counts, stop
 # words, word n-gram tables, words-per-line) — the steps that force
 # dictionary-script documents onto the host oracle (see __init__).
@@ -266,7 +283,7 @@ class CompiledPipeline:
         self.config = config
         self.buckets = tuple(sorted(buckets))
         self.mesh = mesh
-        if batch_size is None:
+        if not batch_size:  # None or 0 — the CLI passes ints through unguarded
             batch_size = default_batch_size(self.buckets)
         if mesh is not None:
             n_dev = mesh.devices.size
@@ -303,6 +320,15 @@ class CompiledPipeline:
         self._route_dict_scripts = any(
             s.type in _WORD_TABLE_STEPS for s in self.device_steps
         )
+
+        # Wire format: accelerator uploads dominate TPU pass time (round-5
+        # window: ~0.5 s of a 1.7 s c4 pass was the 32 MB int32 upload at
+        # ~65 MB/s), and BMP codepoints fit uint16 exactly.  Rows containing
+        # supplementary-plane chars (emoji etc.) are routed to the host
+        # oracle instead — decisions stay bit-identical, attribution is the
+        # fallback counter.  Meshes keep int32 (multi-host sharding layers
+        # are not wire-bound the same way; one format keeps lockstep simple).
+        self.wire_u16 = self.mesh is None and _wire_u16()
 
         # Multi-phase short-circuiting: always on single-controller runs
         # (including single-process meshes — one controller dispatches for
@@ -432,6 +458,10 @@ class CompiledPipeline:
         )
 
         def fn(cps, lengths):
+            if self.wire_u16:
+                # Wire is uint16; every kernel computes in int32.  The widen
+                # fuses into the first consumer on device.
+                cps = cps.astype(jnp.int32)
             out: Dict[str, jax.Array] = {}
             state = {"cps": cps, "lengths": lengths, "st": None}
 
@@ -555,7 +585,8 @@ class CompiledPipeline:
                 if key in self._jitted and not hasattr(self._jitted[key], "lower"):
                     continue  # already AOT-compiled
                 fn = self._fn_for(length, phase)
-                cps = jax.ShapeDtypeStruct((self.batch_size, length), jnp.int32)
+                wire = jnp.uint16 if self.wire_u16 else jnp.int32
+                cps = jax.ShapeDtypeStruct((self.batch_size, length), wire)
                 lens = jax.ShapeDtypeStruct((self.batch_size,), jnp.int32)
                 jobs.append((key, fn.lower(cps, lens)))
 
@@ -581,8 +612,9 @@ class CompiledPipeline:
                 raise last
             if warm_dispatch:
                 length = key[0]
+                wire_np = _np.uint16 if self.wire_u16 else _np.int32
                 z = jnp.asarray(
-                    _np.zeros((self.batch_size, length), dtype=_np.int32)
+                    _np.zeros((self.batch_size, length), dtype=wire_np)
                 )
                 zl = jnp.asarray(_np.zeros((self.batch_size,), dtype=_np.int32))
                 jax.block_until_ready(compiled(z, zl))
@@ -1096,6 +1128,16 @@ class CompiledPipeline:
             cps, lengths = shard_batch(self.mesh, batch.cps, batch.lengths)
         else:
             cps, lengths = batch.cps, batch.lengths
+            if self.wire_u16:
+                # Astral rows were routed to the host oracle upstream
+                # (process_chunk); a slip here would truncate silently, so
+                # guard with one cheap vectorized check.
+                if int(cps.max(initial=0)) >= 0x10000:
+                    raise RuntimeError(
+                        "astral codepoint reached the uint16 wire — "
+                        "routing invariant broken"
+                    )
+                cps = cps.astype(np.uint16)
         return fn(cps, lengths)
 
     def assemble_phase(
@@ -1175,19 +1217,19 @@ class CompiledPipeline:
 
         debug = os.environ.get("TEXTBLAST_PHASE_DEBUG") == "1"
         current: List[TextDocument] = docs
-        if self._route_dict_scripts:
-            from ..utils.cjk import has_dict_script
+        if self._route_dict_scripts or self.wire_u16:
+            from ..utils.cjk import has_astral, has_dict_script
 
-            kept: List[TextDocument] = []
-            for doc in current:
-                if has_dict_script(doc.content):
-                    METRICS.inc("worker_host_fallback_total")
-                    outcome = execute_processing_pipeline(self.host_executor, doc)
-                    if outcome is not None:
-                        yield outcome
-                else:
-                    kept.append(doc)
-            current = kept
+            route_dict = self._route_dict_scripts
+            route_astral = self.wire_u16
+
+            def _host_routed(doc: TextDocument) -> bool:
+                return (route_dict and has_dict_script(doc.content)) or (
+                    route_astral and has_astral(doc.content)
+                )
+
+        else:
+            _host_routed = None
         for phase in range(len(self.phases)):
             t0 = time.perf_counter()
             t_dispatch = t_assemble = 0.0
@@ -1213,6 +1255,8 @@ class CompiledPipeline:
                 batch_size=self.batch_size,
                 buckets=self.buckets,
                 host_tail_max=host_tail_max,
+                # Phase 0 only: later phases' survivors already passed it.
+                route_fn=_host_routed if phase == 0 else None,
             ):
                 if batch is not None:
                     n_batches += 1
@@ -1229,10 +1273,15 @@ class CompiledPipeline:
                         yield from outcomes
                     pending = (batch, stats, phase)
                 for doc in fallback:
-                    # Over-length docs are genuine fallbacks; leftover tail
-                    # groups are deliberate routing — count them apart so
-                    # the bench's honesty metric stays meaningful.
-                    if len(doc.content) > over_length:
+                    # Over-length and routed (dict-script/astral) docs are
+                    # genuine fallbacks; leftover tail groups are deliberate
+                    # routing — count them apart so the bench's honesty
+                    # metric stays meaningful.
+                    if len(doc.content) > over_length or (
+                        _host_routed is not None
+                        and phase == 0
+                        and _host_routed(doc)
+                    ):
                         METRICS.inc("worker_host_fallback_total")
                     else:
                         METRICS.inc("worker_host_tail_total")

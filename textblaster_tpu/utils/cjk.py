@@ -34,10 +34,22 @@ from typing import Dict, List, Sequence, Set, Tuple
 
 __all__ = [
     "DICT_SCRIPT_RE",
+    "has_astral",
     "has_dict_script",
     "segment_span",
     "zh_lexicon",
 ]
+
+# Supplementary-plane codepoints (emoji, rare CJK extensions, historic
+# scripts).  Not a CJK concern per se, but the same routing machinery uses
+# it: the device wire format is uint16 on accelerators
+# (ops/pipeline.py), so astral rows take the host oracle.
+_ASTRAL_RE = re.compile("[\U00010000-\U0010FFFF]")
+
+
+def has_astral(text: str) -> bool:
+    """True if any char of ``text`` is outside the BMP."""
+    return _ASTRAL_RE.search(text) is not None
 
 # Scripts ICU segments by dictionary: Han (+ext A, compat), Hiragana,
 # Katakana (+phonetic ext), Thai.  (Lao/Khmer/Myanmar are also dictionary
