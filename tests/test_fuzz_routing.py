@@ -1,0 +1,112 @@
+"""Differential fuzz over the round-5 host-routing classes in combination:
+uint16 wire + astral rows, dictionary-script rows, and badwords fold-hazard
+rows, mixed into ordinary Danish/English text in one stream.  Every routing
+decision must leave outcomes bit-identical to the pure host oracle.
+"""
+
+import numpy as np
+import pytest
+
+from textblaster_tpu.config.pipeline import parse_pipeline_config
+from textblaster_tpu.data_model import TextDocument
+from textblaster_tpu.ops.pipeline import CompiledPipeline, process_documents_device
+from textblaster_tpu.orchestration import process_documents_host
+from textblaster_tpu.pipeline_builder import build_pipeline_from_config
+from textblaster_tpu.utils.synthwords import synth_badwords
+
+from tests.test_device_parity import assert_outcomes_equal
+
+SEED = 20260731
+
+YAML_TEMPLATE = """
+pipeline:
+  - type: LanguageDetectionFilter
+    min_confidence: 0.4
+    allowed_languages: [ "dan", "eng", "swe", "nob", "nno" ]
+  - type: C4BadWordsFilter
+    default_language: en
+    keep_fraction: 0.0
+    fail_on_missing_language: false
+  - type: GopherQualityFilter
+    min_doc_words: 3
+    min_stop_words: 0
+    min_avg_word_length: 1.0
+    max_avg_word_length: 20.0
+    max_symbol_word_ratio: 0.9
+    max_bullet_lines_ratio: 1.0
+    max_ellipsis_lines_ratio: 1.0
+    max_non_alpha_words_ratio: 1.0
+"""
+
+_BASE_WORDS = (
+    "det er en god dag og vi skal ud at gå tur i skoven the quick brown fox "
+    "jumps over lazy dog and runs through green fields near river"
+).split()
+
+# Routing triggers sprinkled into documents.
+_SPICE = [
+    "😀",            # astral (u16 wire route)
+    "🎉🎊",          # astral run
+    "𝒜",             # plane-1 letter
+    "中文词汇",       # Han (dict-script route)
+    "ひらがな",       # kana
+    "ſ",             # fold-hazard partner of 's'
+    "ı",             # fold-hazard partner of 'i'
+    "İ",             # multi-char lower
+    "K",             # Kelvin sign: NOT hazardous (table-expressible)
+    "σπαμ",          # Greek (final-sigma hazard family)
+]
+
+
+def _make_docs(rng, n, badwords):
+    docs = []
+    for i in range(n):
+        words = [
+            _BASE_WORDS[int(rng.integers(0, len(_BASE_WORDS)))]
+            for _ in range(int(rng.integers(4, 24)))
+        ]
+        # ~40%: inject one spice token at a random position.
+        if rng.random() < 0.4:
+            words.insert(
+                int(rng.integers(0, len(words) + 1)),
+                _SPICE[int(rng.integers(0, len(_SPICE)))],
+            )
+        # ~15%: inject a real badword (device-visible match).
+        if rng.random() < 0.15:
+            words.insert(
+                int(rng.integers(0, len(words) + 1)),
+                badwords[int(rng.integers(0, len(badwords)))],
+            )
+        docs.append(
+            TextDocument(id=f"f{i}", source="t", content=" ".join(words))
+        )
+    return docs
+
+
+@pytest.mark.parametrize("wire", ["u16", "cp32"])
+def test_fuzz_routing_classes_match_oracle(tmp_path, monkeypatch, wire):
+    monkeypatch.setenv("TEXTBLAST_WIRE", wire)
+    monkeypatch.setenv("TEXTBLAST_HOST_TAILS", "off")
+    rng = np.random.default_rng(SEED + (0 if wire == "u16" else 1))
+    words = synth_badwords(606, n=120)
+    (tmp_path / "en").write_text("\n".join(words) + "\n", encoding="utf-8")
+    config = parse_pipeline_config(YAML_TEMPLATE)
+    config.pipeline[1].params.cache_base_path = tmp_path
+
+    docs = _make_docs(rng, 160, words)
+    host = {
+        o.document.id: o
+        for o in process_documents_host(
+            build_pipeline_from_config(config), iter([d.copy() for d in docs])
+        )
+    }
+    pipeline = CompiledPipeline(config, batch_size=16, buckets=(512,))
+    dev = {
+        o.document.id: o
+        for o in process_documents_device(config, iter(docs), pipeline=pipeline)
+    }
+    assert set(host) == set(dev)
+    # Shared comparator: kind + reason + content + metadata equality
+    # (run_both itself is not reusable here — cache_base_path is
+    # programmatic-only, so the config cannot come from bare YAML).
+    assert_outcomes_equal(host, dev)

@@ -989,6 +989,12 @@ class CompiledPipeline:
             host_step = self._badwords_host_step(idx)
             doc_lang = doc.metadata.get("language", p.default_language)
             m = matches.get(doc_lang)
+            if m is not None and hazards[doc_lang][row]:
+                # Observability: host-regex re-decisions for fold-hazard
+                # rows are host-path work (one regex search, not a full
+                # pipeline rerun) — counted under their own name so bench
+                # honesty metrics stay complete.
+                METRICS.inc("worker_fold_hazard_rows_total")
             if m is None or hazards[doc_lang][row]:
                 # Uncompiled language, or the row contains a codepoint whose
                 # IGNORECASE folding this language's table cannot express
