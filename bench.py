@@ -610,6 +610,7 @@ def main() -> int:
 
     fallbacks_before = METRICS.get("worker_host_fallback_total")
     tails_before = METRICS.get("worker_host_tail_total")
+    hazards_before = METRICS.get("worker_fold_hazard_rows_total")
     load_before_dev = os.getloadavg()[0]
     device_pass_s = []
     device_cpu_frac = []  # meaningful on the cpu platform; low on TPU (waits)
@@ -641,6 +642,11 @@ def main() -> int:
     )
     tail_frac = round(
         (METRICS.get("worker_host_tail_total") - tails_before)
+        / max(3 * len(run_docs), 1),
+        4,
+    )
+    fold_hazard_frac = round(
+        (METRICS.get("worker_fold_hazard_rows_total") - hazards_before)
         / max(3 * len(run_docs), 1),
         4,
     )
@@ -734,6 +740,10 @@ def main() -> int:
         # groups (scheduling choice, distinct from fallbacks; the host path
         # is bit-exact, so parity is unaffected — only throughput attribution).
         "host_tail_frac": tail_frac,
+        # Bad-words rows re-decided by the host regex (fold-hazard
+        # codepoints) during the timed passes — per-row regex work, the
+        # third and finest host-path class.
+        "fold_hazard_frac": fold_hazard_frac,
     }
     if probe_failures:
         result["probe_failures"] = probe_failures

@@ -66,6 +66,17 @@ _SPECS: Dict[str, Tuple[str, str]] = {
         "Documents deliberately routed to the host oracle as end-of-stream "
         "tail groups too small to justify a padded device batch",
     ),
+    "worker_fold_hazard_rows_total": (
+        "counter",
+        "Bad-words rows containing an IGNORECASE fold-hazard codepoint, "
+        "re-decided by the host regex during batch assembly (per-row regex "
+        "work, not a full pipeline fallback)",
+    ),
+    "worker_tokenizer_standin_total": (
+        "counter",
+        "TokenCounter instances that fell back to the vendored stand-in "
+        "tokenizer (counts differ from the hub tokenizer)",
+    ),
 }
 
 
