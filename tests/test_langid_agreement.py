@@ -19,15 +19,23 @@ da/sv/nb/nn text exists in this offline image — provenance discussion in
 PARITY.md); they are disjoint from the training prose and were written
 before scoring.
 
-Measured at round 5:
+Measurement protocol (round 5): the 1000-sentence corpus is the
+DEVELOPMENT set — the contrast lexicon (langid_data.EXTRA_WORDS) was
+iterated against its confusions in rounds 4-5, so accuracy on it is partly
+in-sample for the lexicon.  The honest out-of-sample estimate comes from
+``tests/data/langid_holdout.tsv``: 150 sentences (30/language, parallel
+scenarios — adversarial by construction), authored AFTER the lexicon was
+frozen and scored exactly once, never tuned against.
 
-* overall accuracy:              0.982  (982/1000)
-* round-4 block alone:           0.996  (Bokmål 0.98 — VERDICT asked >=0.97)
-* parallel block alone:          0.968  (Bokmål 0.92: every miss has a
-  near-identical Danish or Nynorsk twin sentence in-corpus)
-* English 1.00; Danish/Swedish 0.99; Nynorsk 0.98; Bokmål 0.95 combined
-* residual confusions stay inside {Bokmål, Nynorsk, Danish} — the
-  orthographically near-identical triangle, lingua's documented hard case.
+Measured at round 5 (frozen model; one corpus repair — an nn dev sentence
+was accidentally string-identical to its nb twin, hence unlabelable):
+
+* dev overall:                   0.982  (996/1000 on the independent block
+  = 0.996, Bokmål 0.98; 0.968 on the parallel block, Bokmål 0.92)
+* HOLDOUT (one-shot):            0.940  — eng 1.00, swe 1.00, dan 0.93,
+  nob 0.93, nno 0.83; all 9 misses inside the {nob, nno, dan} triangle,
+  lingua's documented hard case.  Parallel holdout content means every
+  sentence has four near-identical twins — natural web text is easier.
 
 The floors asserted here are a step below the measured values to allow for
 benign retraining noise; genuine regressions (e.g. profile-table breakage)
@@ -87,6 +95,31 @@ def test_labeled_corpus_agreement():
     for lang in ("nno", "nob"):
         acc = by_lang[lang][0] / by_lang[lang][1]
         assert acc >= 0.93, f"{lang}: {acc:.3f}"
+
+
+HOLDOUT = Path(__file__).parent / "data" / "langid_holdout.tsv"
+
+
+def test_holdout_one_shot_floors():
+    """Regression floors a step below the single frozen-model measurement
+    (0.940 overall).  This set must NEVER be tuned against — if a floor
+    trips, fix the model on the dev corpus and re-verify here."""
+    model = LangIdModel()
+    by_lang = defaultdict(lambda: [0, 0])
+    for line in HOLDOUT.read_text(encoding="utf-8").splitlines():
+        if not line.strip():
+            continue
+        lang, text = line.split("	", 1)
+        name, _conf = model.detect(text)
+        by_lang[lang][0] += NAME_TO_ISO[name] == lang
+        by_lang[lang][1] += 1
+    total = sum(t for _, t in by_lang.values())
+    correct = sum(c for c, _ in by_lang.values())
+    assert total == 150
+    assert correct / total >= 0.90, f"holdout overall {correct/total:.3f}"
+    for lang, (c, t) in by_lang.items():
+        floor = 0.78 if lang == "nno" else 0.88
+        assert c / t >= floor, f"{lang}: {c}/{t}"
 
 
 def test_short_fragments_stay_uncertain():

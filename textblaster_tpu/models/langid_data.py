@@ -537,8 +537,13 @@ TRAIN_TEXT = {
 # Curated common-vocabulary lexicon (flat weight, not Zipf-ranked): frequent
 # content-word FORMS whose orthography separates the close pairs — Danish
 # ud-/-hed/-tion/skov/fik vs Bokmål ut-/-het/-sjon/skog/fikk vs Nynorsk
-# -inga/kva/ikkje/vart, Swedish -ning/och/ä.  General newspaper vocabulary,
-# not tied to any evaluation fixture.
+# -inga/kva/ikkje/vart, Swedish -ning/och/ä.  Provenance: general newspaper
+# vocabulary plus contrast forms added in rounds 4-5 while iterating against
+# the development corpus's confusions (tests/data/langid_corpus.tsv) — that
+# corpus is therefore IN-SAMPLE for this lexicon; the out-of-sample estimate
+# comes from the one-shot holdout set (tests/data/langid_holdout.tsv),
+# authored after the lexicon was frozen and scored exactly once
+# (tests/test_langid_agreement.py).
 EXTRA_WORDS = {
     "Danish": """af ud op ind ned hen hvad hvor hvordan hvorfor hvornår ikke efter sidste først
 mellem gennem igennem uden inden indenfor udenfor omkring måske allerede altid aldrig
