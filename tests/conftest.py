@@ -48,3 +48,19 @@ os.environ.setdefault("TEXTBLAST_HOST_TAILS", "off")
 from textblaster_tpu.utils.compile_cache import enable_compilation_cache  # noqa: E402
 
 enable_compilation_cache()
+
+
+# Fault-injection hygiene: FAULTS is process-global, so an armed fault leaking
+# out of one test would poison every later one.  Reset around each test; the
+# tier-1 guard test (test_fault_injection.py) separately asserts the injector
+# is inert in production paths.
+import pytest  # noqa: E402
+
+from textblaster_tpu.resilience.faults import FAULTS  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()

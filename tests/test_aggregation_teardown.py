@@ -1,0 +1,128 @@
+"""Writer-teardown discipline in ``aggregate_results_from_stream``:
+
+* a mid-stream failure must reach the caller even when flushing/closing the
+  writers also fails (the primary exception is never masked);
+* a teardown failure on one writer must not leak the other writer's handle;
+* on a clean exit a teardown failure is a real failure and propagates.
+"""
+
+import pytest
+
+from textblaster_tpu import orchestration
+from textblaster_tpu.data_model import ProcessingOutcome, TextDocument
+from textblaster_tpu.orchestration import aggregate_results_from_stream
+
+
+class FakeWriter:
+    """Stands in for both Parquet writers; failure modes armed per path."""
+
+    instances = []
+
+    def __init__(self, path):
+        self.path = path
+        self.batches = []
+        self.closed = False
+        self.fail_write = False
+        self.fail_close = False
+        FakeWriter.instances.append(self)
+
+    def write_batch(self, docs):
+        if self.fail_write:
+            raise OSError(f"disk full writing {self.path}")
+        self.batches.append(list(docs))
+
+    def close(self):
+        if self.fail_close:
+            self.closed = True  # handle released even when close errors
+            raise OSError(f"close failed for {self.path}")
+        self.closed = True
+
+
+@pytest.fixture
+def writers(monkeypatch, tmp_path):
+    FakeWriter.instances = []
+    monkeypatch.setattr(orchestration, "ParquetWriter", FakeWriter)
+    out = str(tmp_path / "out.parquet")
+    excl = str(tmp_path / "excl.parquet")
+    yield out, excl
+
+
+def _success(i):
+    return ProcessingOutcome.success(TextDocument(id=f"doc-{i}", content="x"))
+
+
+def _filtered(i):
+    return ProcessingOutcome.filtered(
+        TextDocument(id=f"doc-{i}", content="x"), "short"
+    )
+
+
+def _dying_stream(n_success=3, n_filtered=2):
+    for i in range(n_success):
+        yield _success(i)
+    for i in range(n_filtered):
+        yield _filtered(n_success + i)
+    raise RuntimeError("stream died mid-run")
+
+
+def test_clean_run_flushes_and_closes(writers):
+    out, excl = writers
+    result = aggregate_results_from_stream(
+        iter([_success(0), _success(1), _filtered(2)]), out, excl
+    )
+    assert (result.success, result.filtered) == (2, 1)
+    out_w, excl_w = FakeWriter.instances
+    assert [len(b) for b in out_w.batches] == [2]
+    assert [len(b) for b in excl_w.batches] == [1]
+    assert out_w.closed and excl_w.closed
+
+
+def test_stream_failure_not_masked_by_flush_failure(writers):
+    out, excl = writers
+    stream = _dying_stream()
+
+    def arm_then_stream():
+        # Arm the failure after the writers exist (first outcome is enough).
+        for outcome in stream:
+            FakeWriter.instances[0].fail_write = True
+            yield outcome
+
+    with pytest.raises(RuntimeError, match="stream died"):
+        aggregate_results_from_stream(arm_then_stream(), out, excl)
+    out_w, excl_w = FakeWriter.instances
+    # The failed kept-file flush neither masked the stream error nor stopped
+    # the excluded remainder flush or either close.
+    assert [len(b) for b in excl_w.batches] == [2]
+    assert out_w.closed and excl_w.closed
+
+
+def test_stream_failure_not_masked_by_close_failure(writers):
+    out, excl = writers
+
+    def arm_then_stream():
+        for outcome in _dying_stream():
+            FakeWriter.instances[0].fail_close = True
+            FakeWriter.instances[1].fail_close = True
+            yield outcome
+
+    with pytest.raises(RuntimeError, match="stream died"):
+        aggregate_results_from_stream(arm_then_stream(), out, excl)
+    out_w, excl_w = FakeWriter.instances
+    assert out_w.closed and excl_w.closed  # both handles released
+
+
+def test_clean_exit_teardown_failure_propagates(writers):
+    out, excl = writers
+
+    def arm_then_stream():
+        for i, outcome in enumerate([_success(0), _filtered(1)]):
+            FakeWriter.instances[0].fail_close = True
+            yield outcome
+
+    with pytest.raises(OSError, match="close failed"):
+        aggregate_results_from_stream(arm_then_stream(), out, excl)
+    out_w, excl_w = FakeWriter.instances
+    # The excluded writer was still flushed and closed despite the kept
+    # writer's close failure.
+    assert [len(b) for b in excl_w.batches] == [1]
+    assert excl_w.closed

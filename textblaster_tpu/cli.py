@@ -49,6 +49,12 @@ def build_parser() -> argparse.ArgumentParser:
                      help="Path to the output Parquet file")
     run.add_argument("-e", "--excluded-file", default="excluded.parquet",
                      help="Path to the excluded output Parquet file")
+    run.add_argument("--errors-file", default=None,
+                     help="Opt-in dead-letter Parquet file: every Error "
+                          "outcome and every unreadable/quarantined row "
+                          "lands here with step/reason/worker columns.  "
+                          "Default: no file (the reference's behavior — "
+                          "errored rows appear in neither output)")
     run.add_argument("--backend", choices=("host", "tpu", "cpu"), default="tpu",
                      help="Execution backend: compiled pipeline on the "
                           "accelerator (tpu), the same compiled pipeline "
@@ -156,6 +162,10 @@ def _cmd_run(args: argparse.Namespace) -> int:
         print("--coordinator requires the compiled pipeline "
               "(--backend tpu or cpu, not host)", file=sys.stderr)
         return 1
+    if args.coordinator and args.errors_file:
+        print("--errors-file is not supported with --coordinator yet "
+              "(per-host dead-letter shards are not merged)", file=sys.stderr)
+        return 1
 
     try:
         if args.coordinator:
@@ -198,6 +208,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
                 device_batch=args.device_batch,
                 buckets=buckets,
                 progress=progress.update,
+                errors_file=args.errors_file,
             )
             progress.finish()
         else:
@@ -215,6 +226,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
                 device_batch=args.device_batch,
                 buckets=buckets,
                 quiet=args.quiet,
+                errors_file=args.errors_file,
             )
     except PipelineError as e:
         print(f"Pipeline run failed: {e}", file=sys.stderr)
@@ -228,8 +240,29 @@ def _cmd_run(args: argparse.Namespace) -> int:
         f"Processed {total} documents in {elapsed:.2f}s ({rate:.1f} docs/sec): "
         f"{result.success} kept -> {args.output_file}, "
         f"{result.filtered} excluded -> {args.excluded_file}, "
-        f"{result.errors} errored (in neither file)."
+        f"{result.errors} errored "
+        + (
+            f"-> {args.errors_file}."
+            if args.errors_file
+            else "(in neither file)."
+        )
     )
+    deadlettered = int(METRICS.get("deadletter_rows_total"))
+    if args.errors_file and deadlettered:
+        print(
+            f"Dead-letter rows: {deadlettered} -> {args.errors_file} "
+            "(errored + unreadable)."
+        )
+    tripped = int(METRICS.get("resilience_breaker_trips_total"))
+    if tripped:
+        print(
+            "Warning: device circuit breaker tripped — the run degraded to "
+            "the host backend after repeated device failures "
+            f"(retries={int(METRICS.get('resilience_retries_total'))}, "
+            f"host-rung docs="
+            f"{int(METRICS.get('resilience_ladder_host_total'))}).",
+            file=sys.stderr,
+        )
     fallbacks = int(
         METRICS.get("worker_host_fallback_total") - fallbacks_before
     )

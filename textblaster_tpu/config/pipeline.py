@@ -26,6 +26,7 @@ __all__ = [
     "LanguageDetectionParams",
     "FineWebQualityFilterParams",
     "TokenCounterParams",
+    "ResilienceConfig",
     "load_pipeline_config",
     "parse_pipeline_config",
 ]
@@ -300,6 +301,63 @@ _SKIPPED_FIELDS = {"C4BadWordsFilter": ("cache_base_path",)}
 
 
 @dataclass
+class ResilienceConfig:
+    """Fault-tolerance knobs for the execution layer (no reference
+    equivalent — the reference leans on RabbitMQ redelivery).
+
+    Parsed from an optional top-level ``resilience:`` mapping in the pipeline
+    YAML.  Deliberately excluded from the checkpoint config fingerprint
+    (checkpoint.py hashes ``config.pipeline`` only): retry budgets do not
+    change outcomes, so tuning them must not invalidate a resumable run.
+    """
+
+    max_retries: int = 3          # re-attempts after the first try, per seam
+    backoff_base_s: float = 0.05  # first backoff delay
+    backoff_max_s: float = 2.0    # backoff cap
+    backoff_multiplier: float = 2.0
+    jitter: float = 0.5           # each delay widened by up to this fraction
+    breaker_threshold: int = 3    # consecutive device failures before the trip
+    split_retry: bool = True      # enable the split-in-half OOM rung
+
+    def validate(self) -> None:
+        if self.max_retries < 0:
+            raise ConfigValidationError(
+                "ResilienceConfig: max_retries must be non-negative"
+            )
+        for name, val in (
+            ("backoff_base_s", self.backoff_base_s),
+            ("backoff_max_s", self.backoff_max_s),
+            ("jitter", self.jitter),
+        ):
+            if val < 0.0:
+                raise ConfigValidationError(
+                    f"ResilienceConfig: {name} must be non-negative, got {val}"
+                )
+        if self.backoff_multiplier < 1.0:
+            raise ConfigValidationError(
+                "ResilienceConfig: backoff_multiplier must be >= 1.0, "
+                f"got {self.backoff_multiplier}"
+            )
+        if self.breaker_threshold < 1:
+            raise ConfigValidationError(
+                "ResilienceConfig: breaker_threshold must be >= 1, "
+                f"got {self.breaker_threshold}"
+            )
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ResilienceConfig":
+        if not isinstance(d, dict):
+            raise ConfigError("`resilience` must be a mapping")
+        known = set(cls.__dataclass_fields__)
+        # serde-without-deny_unknown_fields parity: extra keys are ignored.
+        fields_d = {k: v for k, v in d.items() if k in known}
+        try:
+            return cls(**fields_d)
+        except TypeError as e:
+            raise ConfigError(f"invalid resilience config: {e}") from e
+
+
+@dataclass
 class StepConfig:
     """One pipeline step: a type tag + typed params (pipeline.rs:26-64)."""
 
@@ -351,13 +409,15 @@ class StepConfig:
 
 @dataclass
 class PipelineConfig:
-    """pipeline.rs:10-22"""
+    """pipeline.rs:10-22 (+ the optional resilience section, ours only)."""
 
     pipeline: List[StepConfig]
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
 
     def validate(self) -> None:
         for step in self.pipeline:
             step.validate()
+        self.resilience.validate()
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "PipelineConfig":
@@ -366,7 +426,15 @@ class PipelineConfig:
         steps_raw = d["pipeline"]
         if steps_raw is None or not isinstance(steps_raw, list):
             raise ConfigError("`pipeline` must be a list of steps")
-        return cls(pipeline=[StepConfig.from_dict(s) for s in steps_raw])
+        resilience_raw = d.get("resilience")
+        return cls(
+            pipeline=[StepConfig.from_dict(s) for s in steps_raw],
+            resilience=(
+                ResilienceConfig.from_dict(resilience_raw)
+                if resilience_raw is not None
+                else ResilienceConfig()
+            ),
+        )
 
 
 def parse_pipeline_config(content: str, origin: str = "<string>") -> PipelineConfig:

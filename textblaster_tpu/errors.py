@@ -26,6 +26,7 @@ __all__ = [
     "SerializationError",
     "UnexpectedError",
     "CheckpointError",
+    "RetryExhaustedError",
 ]
 
 
@@ -116,3 +117,23 @@ class CheckpointError(PipelineError):
 
     def __str__(self) -> str:
         return f"Checkpoint error: {self.args[0] if self.args else ''}"
+
+
+class RetryExhaustedError(PipelineError):
+    """A guarded seam kept failing with transient faults until the retry
+    budget ran out (no reference equivalent — the reference leans on broker
+    redelivery).  Carries the seam name and the last underlying error; the
+    inner message is preserved verbatim so transient-fault markers (e.g.
+    ``RESOURCE_EXHAUSTED``) stay visible to the degradation ladder."""
+
+    def __init__(self, seam: str, attempts: int, last: BaseException) -> None:
+        super().__init__(seam, attempts, last)
+        self.seam = seam
+        self.attempts = attempts
+        self.last = last
+
+    def __str__(self) -> str:
+        return (
+            f"Retries exhausted at seam '{self.seam}' after {self.attempts} "
+            f"attempt(s); last error: {self.last}"
+        )

@@ -26,13 +26,16 @@ import html
 import json
 import logging
 from dataclasses import dataclass
-from typing import Iterator, Optional, Union
+from typing import Callable, Iterator, Optional, Union
 
 import pyarrow as pa
 import pyarrow.parquet as pq
 
 from ..data_model import TextDocument
 from ..errors import ConfigError, ParquetError, PipelineError, UnexpectedError
+from ..resilience.faults import FAULTS
+from ..resilience.retry import RetryPolicy
+from ..utils.metrics import METRICS
 from .base import BaseReader
 
 logger = logging.getLogger(__name__)
@@ -75,9 +78,39 @@ def _to_datetime(value):
     return None
 
 
+# Module-default policy for the read seam: every reader is guarded even when
+# the caller didn't thread an explicit policy through (run_pipeline does).
+_DEFAULT_READ_RETRY: Optional[RetryPolicy] = None
+
+
+def _default_read_retry() -> RetryPolicy:
+    global _DEFAULT_READ_RETRY
+    if _DEFAULT_READ_RETRY is None:
+        _DEFAULT_READ_RETRY = RetryPolicy()
+    return _DEFAULT_READ_RETRY
+
+
+class _QuarantinedGroup:
+    """Sentinel for a row group that stayed unreadable through the retry
+    budget: carries how many input rows it held so consumers can keep the
+    item<->row accounting exact (the checkpoint cursor depends on it)."""
+
+    __slots__ = ("group", "num_rows", "error")
+
+    def __init__(self, group: int, num_rows: int, error: BaseException) -> None:
+        self.group = group
+        self.num_rows = num_rows
+        self.error = error
+
+
 class ParquetReader(BaseReader):
-    def __init__(self, config: ParquetInputConfig) -> None:
+    def __init__(
+        self,
+        config: ParquetInputConfig,
+        retry_policy: Optional[RetryPolicy] = None,
+    ) -> None:
         self.config = config
+        self.retry_policy = retry_policy
 
     def _open(self) -> pq.ParquetFile:
         try:
@@ -98,35 +131,81 @@ class ParquetReader(BaseReader):
                 f"found: {text_type}"
             )
 
-    def read_batches(self, skip_rows: int = 0) -> Iterator[pa.RecordBatch]:
-        """Raw Arrow record batches (the zero-copy path for the TPU packer).
+    def _fetch_group(self, pf: pq.ParquetFile, group: int) -> pa.Table:
+        """One row group off disk — the guarded read seam.  The fault site
+        fires *inside* the retried callable so chaos tests drive the retry
+        layer through real control flow."""
+        policy = self.retry_policy or _default_read_retry()
+
+        def fetch() -> pa.Table:
+            FAULTS.fire("read.batch")
+            return pf.read_row_group(group)
+
+        return policy.run(fetch, seam="read")
+
+    def _iter_group_batches(
+        self, skip_rows: int = 0, on_quarantine=None
+    ) -> Iterator[Union[pa.RecordBatch, _QuarantinedGroup]]:
+        """Record batches row-group by row-group, each group fetched under
+        the read RetryPolicy.
 
         ``skip_rows`` seeks past the first N rows without decoding them:
         fully-consumed row groups are never read (their ``num_rows`` come
         from the footer), and only the partially-consumed group is sliced —
         the row-group cursor the checkpoint subsystem resumes from.
+
+        A group that stays unreadable through the retry budget is yielded as
+        a :class:`_QuarantinedGroup` when ``on_quarantine`` is truthy
+        (reading continues at the next group); otherwise the error
+        propagates as :class:`ParquetError`.
         """
         pf = self._open()
         self._validate_schema(pf.schema_arrow)
         batch_size = self.config.batch_size or 1024
-
-        if skip_rows <= 0:
-            yield from pf.iter_batches(batch_size=batch_size)
-            return
 
         md = pf.metadata
         groups = list(range(md.num_row_groups))
         while groups and skip_rows >= md.row_group(groups[0]).num_rows:
             skip_rows -= md.row_group(groups[0]).num_rows
             groups.pop(0)
-        for batch in pf.iter_batches(batch_size=batch_size, row_groups=groups):
-            if skip_rows:
-                if batch.num_rows <= skip_rows:
-                    skip_rows -= batch.num_rows
-                    continue
-                batch = batch.slice(skip_rows)
+
+        for g in groups:
+            n_rows = md.row_group(g).num_rows
+            try:
+                table = self._fetch_group(pf, g)
+            except Exception as e:  # noqa: BLE001 — budget already spent
+                if not on_quarantine:
+                    if isinstance(e, ParquetError):
+                        raise
+                    raise ParquetError(
+                        f"failed to read row group {g} of "
+                        f"'{self.config.path}': {e}"
+                    ) from e
+                # Quarantine: account every not-yet-consumed row of the
+                # group so item<->row bookkeeping stays exact.
+                lost = n_rows - skip_rows
                 skip_rows = 0
-            yield batch
+                METRICS.inc("resilience_quarantined_rows_total", lost)
+                logger.error(
+                    "Quarantined row group %d of '%s' (%d rows): %s",
+                    g, self.config.path, lost, e,
+                )
+                yield _QuarantinedGroup(g, lost, e)
+                continue
+            if skip_rows:
+                table = table.slice(skip_rows)
+                skip_rows = 0
+            for batch in table.to_batches(max_chunksize=batch_size):
+                if batch.num_rows:
+                    yield batch
+
+    def read_batches(self, skip_rows: int = 0) -> Iterator[pa.RecordBatch]:
+        """Raw Arrow record batches (the zero-copy path for the TPU packer).
+
+        Reads are guarded by the retry policy; an unreadable row group
+        raises :class:`ParquetError` here (use :meth:`read_documents` for
+        the quarantining form)."""
+        yield from self._iter_group_batches(skip_rows=skip_rows)
 
     def read_documents(
         self, skip_rows: int = 0
@@ -143,7 +222,20 @@ class ParquetReader(BaseReader):
             if md_type not in (pa.string(), pa.large_string()):
                 has["metadata"] = False
 
-        for batch in self.read_batches(skip_rows=skip_rows):
+        for batch in self._iter_group_batches(
+            skip_rows=skip_rows, on_quarantine=True
+        ):
+            if isinstance(batch, _QuarantinedGroup):
+                # One error item PER LOST ROW, not per group: the stream's
+                # item count must equal the input row count for the
+                # checkpoint cursor's row-exact resume skip.
+                q = batch
+                for _ in range(q.num_rows):
+                    yield ParquetError(
+                        f"row quarantined: row group {q.group} of "
+                        f"'{self.config.path}' unreadable: {q.error}"
+                    )
+                continue
             cols = {name: batch.column(i) for i, name in enumerate(batch.schema.names)}
             text_col = cols[self.config.text_column]
             id_col = cols[self.config.id_column]

@@ -30,9 +30,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..config.pipeline import PipelineConfig, StepConfig
+from ..config.pipeline import PipelineConfig, ResilienceConfig, StepConfig
 from ..data_model import ProcessingOutcome, TextDocument
-from ..errors import PipelineError
+from ..errors import PipelineError, RetryExhaustedError
 from ..filters.c4_quality import CITATION_RE
 from ..filters.common import fmt2, fmt4, rust_bool, rust_float, rust_lines
 from ..filters.gopher_quality import DEFAULT_STOP_WORDS
@@ -40,10 +40,19 @@ from ..filters.fineweb_quality import DEFAULT_STOP_CHARS
 from ..models.langid import ISO_TO_NAME, LANGUAGES, NAME_TO_ISO, LangIdModel
 from ..orchestration import execute_processing_pipeline
 from ..pipeline_builder import build_pipeline_from_config
+from ..resilience.breaker import CircuitBreaker
+from ..resilience.faults import FAULTS
+from ..resilience.retry import RetryPolicy
 from ..utils.metrics import METRICS
 from .badwords import badwords_matches_multi
 from .langid_tpu import langid_scores
-from .packing import DEFAULT_BUCKETS, PACK_MARGIN, PackedBatch, iter_packed_batches
+from .packing import (
+    DEFAULT_BUCKETS,
+    PACK_MARGIN,
+    PackedBatch,
+    iter_packed_batches,
+    pack_documents,
+)
 from .stats import (
     C4Params,
     c4_stage,
@@ -359,8 +368,17 @@ class CompiledPipeline:
 
         self._host_executor = None
         self._host_suffix_executor = None
-        self._jitted: Dict[Tuple[int, int], Callable] = {}
+        self._jitted: Dict[Tuple, Callable] = {}
         self._badwords_steps: Dict[int, object] = {}
+
+        # Degradation ladder state (see _execute_packed): retry the batch ->
+        # split it in half -> rerun the docs on the host oracle, with a
+        # breaker that abandons the device path for the run after N
+        # consecutive batches fell all the way to the host rung.
+        rc = getattr(config, "resilience", None) or ResilienceConfig()
+        self._retry = RetryPolicy.from_config(rc)
+        self._breaker = CircuitBreaker(rc.breaker_threshold)
+        self._split_retry = rc.split_retry
 
     def _badwords_host_step(self, idx: int):
         """The real host C4BadWordsFilter for device step ``idx`` — runs only
@@ -539,8 +557,17 @@ class CompiledPipeline:
             )
         return jax.jit(fn)
 
-    def _fn_for(self, length: int, phase: int = 0) -> Callable:
-        key = (length, phase)
+    def _fn_for(
+        self, length: int, phase: int = 0, rows: Optional[int] = None
+    ) -> Callable:
+        """Program for one (bucket length, phase) — and, for the ladder's
+        split rung, a separate cache entry per non-standard row count:
+        ``warmup_parallel`` installs AOT executables fixed to
+        ``(batch_size, length)``, which a half-sized batch must never hit."""
+        if rows is not None and rows != self.batch_size:
+            key = (length, phase, rows)
+        else:
+            key = (length, phase)
         if key not in self._jitted:
             self._jitted[key] = self._build_fn(length, phase)
         return self._jitted[key]
@@ -1127,7 +1154,8 @@ class CompiledPipeline:
         stats WITHOUT blocking (JAX async dispatch) — the caller overlaps the
         previous batch's host-side assembly with this batch's device compute
         (the double-buffered feed SURVEY.md §2.5 maps prefetch/QoS onto)."""
-        fn = self._fn_for(batch.max_len, phase)
+        FAULTS.fire("device.execute")
+        fn = self._fn_for(batch.max_len, phase, rows=batch.batch_size)
         if self.mesh is not None:
             from ..parallel.mesh import shard_batch
 
@@ -1145,6 +1173,98 @@ class CompiledPipeline:
                     )
                 cps = cps.astype(np.uint16)
         return fn(cps, lengths)
+
+    # --- degradation ladder -------------------------------------------------
+
+    def _device_fetch(
+        self, batch: PackedBatch, phase: int, inflight=None
+    ) -> Dict[str, np.ndarray]:
+        """Dispatch + transfer for one batch under the device RetryPolicy.
+
+        ``inflight`` is an already-dispatched stats tree (the overlap path):
+        the first attempt only has to fetch it; every re-attempt re-dispatches
+        from scratch.  Returns host-side numpy stats (``jax.device_get`` on
+        numpy is identity, so ``assemble_phase`` takes them unchanged).
+        """
+        first = [inflight]
+
+        def attempt() -> Dict[str, np.ndarray]:
+            stats = first[0]
+            first[0] = None
+            if stats is None:
+                stats = self.dispatch_batch(batch, phase)
+            return jax.device_get(stats)
+
+        return self._retry.run(attempt, seam="device")
+
+    def _host_rerun(self, docs: List[TextDocument]) -> List[ProcessingOutcome]:
+        """Bottom rung: the full host-oracle pipeline, bit-identical to the
+        device path by the same contract the overflow fallback relies on
+        (docs are re-stamped identically even mid-phase)."""
+        outcomes: List[ProcessingOutcome] = []
+        for doc in docs:
+            METRICS.inc("resilience_ladder_host_total")
+            outcome = execute_processing_pipeline(self.host_executor, doc)
+            if outcome is not None:
+                outcomes.append(outcome)
+        return outcomes
+
+    def _execute_packed(
+        self, batch: PackedBatch, phase: int, inflight=None
+    ) -> Tuple[List[ProcessingOutcome], List[TextDocument]]:
+        """One packed batch through the degradation ladder.
+
+        Rungs: (1) retry the whole batch under the device RetryPolicy;
+        (2) split it in half and retry each half — OOM recovery, and a
+        bisection that saves the healthy half of a poisoned batch; (3) rerun
+        the documents on the host oracle.  Deterministic errors (fatal per
+        the classifier) propagate immediately — the ladder only absorbs
+        transient device faults.  The circuit breaker counts batches that
+        fell to the host rung; once tripped, the run stays on the host
+        backend (no more device dispatches to time out on).
+        """
+        if self._breaker.tripped:
+            return self._host_rerun(batch.docs), []
+        try:
+            stats = self._device_fetch(batch, phase, inflight)
+        except RetryExhaustedError:
+            pass  # descend the ladder below
+        else:
+            self._breaker.record_success()
+            return self.assemble_phase(batch, stats, phase)
+
+        fell_to_host = False
+        outcomes: List[ProcessingOutcome] = []
+        survivors: List[TextDocument] = []
+        if self._split_retry and self.mesh is None and len(batch.docs) > 1:
+            # Split rung.  Both halves pack to the same padded row count so
+            # they share one traced program shape (a fresh jit entry — the
+            # warmup's AOT executables are fixed to the full batch size).
+            METRICS.inc("resilience_ladder_split_total")
+            sub_rows = (self.batch_size + 1) // 2
+            mid = (len(batch.docs) + 1) // 2
+            for part in (batch.docs[:mid], batch.docs[mid:]):
+                if not part:
+                    continue
+                sub = pack_documents(part, sub_rows, batch.max_len)
+                try:
+                    stats = self._device_fetch(sub, phase)
+                except RetryExhaustedError:
+                    fell_to_host = True
+                    outcomes.extend(self._host_rerun(part))
+                else:
+                    o, s = self.assemble_phase(sub, stats, phase)
+                    outcomes.extend(o)
+                    survivors.extend(s)
+        else:
+            fell_to_host = True
+            outcomes.extend(self._host_rerun(batch.docs))
+
+        if fell_to_host:
+            self._breaker.record_failure("device batch fell to host rung")
+        else:
+            self._breaker.record_success()
+        return outcomes, survivors
 
     def assemble_phase(
         self,
@@ -1267,17 +1387,32 @@ class CompiledPipeline:
                 if batch is not None:
                     n_batches += 1
                     td = time.perf_counter()
-                    stats = self.dispatch_batch(batch, phase)
+                    if self._breaker.tripped:
+                        stats = None  # no device dispatch; ladder goes host
+                    else:
+                        try:
+                            stats = self.dispatch_batch(batch, phase)
+                            if os.environ.get("TEXTBLAST_NO_OVERLAP") == "1":
+                                jax.block_until_ready(stats)
+                        except Exception as e:  # noqa: BLE001
+                            if self._retry.classify(e) != "retryable":
+                                raise
+                            # Failed launch: hand the batch to the ladder
+                            # with nothing in flight (its first retry
+                            # attempt re-dispatches).
+                            logger.warning(
+                                "Device dispatch failed (phase %d): %s",
+                                phase, e,
+                            )
+                            stats = None
                     t_dispatch += time.perf_counter() - td
-                    if os.environ.get("TEXTBLAST_NO_OVERLAP") == "1":
-                        jax.block_until_ready(stats)
                     if pending is not None:
                         ta = time.perf_counter()
-                        outcomes, alive = self.assemble_phase(*pending)
+                        outcomes, alive = self._execute_packed(*pending)
                         t_assemble += time.perf_counter() - ta
                         survivors.extend(alive)
                         yield from outcomes
-                    pending = (batch, stats, phase)
+                    pending = (batch, phase, stats)
                 for doc in fallback:
                     # Over-length and routed (dict-script/astral) docs are
                     # genuine fallbacks; leftover tail groups are deliberate
@@ -1296,7 +1431,7 @@ class CompiledPipeline:
                         yield outcome
             if pending is not None:
                 ta = time.perf_counter()
-                outcomes, alive = self.assemble_phase(*pending)
+                outcomes, alive = self._execute_packed(*pending)
                 t_assemble += time.perf_counter() - ta
                 survivors.extend(alive)
                 yield from outcomes

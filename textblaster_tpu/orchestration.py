@@ -59,17 +59,20 @@ def read_documents(
     id_column: str = "id",
     batch_size: int = DEFAULT_READ_BATCH_SIZE,
     skip_rows: int = 0,
+    retry_policy=None,
 ) -> Iterator[Union[TextDocument, PipelineError]]:
     """Stream documents off disk (publish_tasks' reading half,
     producer_logic.rs:30-44).  ``skip_rows`` seeks past committed work on
-    resume without decoding it (row-group cursor)."""
+    resume without decoding it (row-group cursor).  ``retry_policy``
+    overrides the reader's default guard on the row-group read seam."""
     reader = ParquetReader(
         ParquetInputConfig(
             path=input_file,
             text_column=text_column,
             id_column=id_column,
             batch_size=batch_size,
-        )
+        ),
+        retry_policy=retry_policy,
     )
     return reader.read_documents(skip_rows=skip_rows)
 
@@ -132,11 +135,18 @@ def aggregate_results_from_stream(
     excluded_file: str,
     published_count: Optional[int] = None,
     progress: Optional[Callable[[AggregationResult], None]] = None,
+    deadletter=None,
 ) -> AggregationResult:
     """Route outcomes to the kept/excluded Parquet pair
     (producer_logic.rs:109-196).  Broker-independent: accepts any iterable of
     outcomes — the seam the reference's fake-stream tests rely on
-    (producer_tests.rs:324-573)."""
+    (producer_tests.rs:324-573).
+
+    ``deadletter`` (a :class:`~textblaster_tpu.resilience.DeadLetterSink`)
+    additionally receives every Error outcome; the kept/excluded pair still
+    gets neither-file behavior for them, so the default artifacts are
+    byte-identical with or without the sink.
+    """
     import os
 
     for f in (output_file, excluded_file):
@@ -151,6 +161,12 @@ def aggregate_results_from_stream(
     out_batch: list[TextDocument] = []
     excl_batch: list[TextDocument] = []
 
+    # Teardown discipline: each flush/close runs in its own guard so a failed
+    # kept-file flush can neither mask the exception that aborted the stream
+    # nor leak the excluded writer's file handle.  On a clean exit the first
+    # teardown failure (if any) is re-raised; while a primary exception is
+    # propagating, teardown failures are logged and suppressed.
+    primary: Optional[BaseException] = None
     try:
         for outcome in stream:
             result.received += 1
@@ -169,9 +185,12 @@ def aggregate_results_from_stream(
                     excl_writer.write_batch(excl_batch)
                     excl_batch.clear()
             else:
-                # Error outcomes are counted in neither file (rs:168-170).
+                # Error outcomes are counted in neither file (rs:168-170);
+                # the opt-in dead-letter sink is the only place they land.
                 result.errors += 1
                 METRICS.inc("producer_results_error_total")
+                if deadletter is not None:
+                    deadletter.record_outcome(outcome)
             METRICS.inc("producer_results_received_total")
             if progress is not None:
                 progress(result)
@@ -180,12 +199,35 @@ def aggregate_results_from_stream(
 
         if published_count is not None and result.received < published_count:
             logger.warning("Outcome stream closed before all outcomes received.")
+    except BaseException as e:
+        primary = e
+        raise
     finally:
+        teardown_error: Optional[BaseException] = None
+
+        def guarded(step: Callable[[], None]) -> None:
+            nonlocal teardown_error
+            try:
+                step()
+            except BaseException as e:  # noqa: BLE001 — collected, not lost
+                if teardown_error is None:
+                    teardown_error = e
+                else:
+                    logger.error("Additional writer-teardown failure: %s", e)
+
         if out_batch:
-            out_writer.write_batch(out_batch)
+            guarded(lambda: out_writer.write_batch(out_batch))
         if excl_batch:
-            excl_writer.write_batch(excl_batch)
-        out_writer.close()
-        excl_writer.close()
+            guarded(lambda: excl_writer.write_batch(excl_batch))
+        guarded(out_writer.close)
+        guarded(excl_writer.close)
+        if teardown_error is not None:
+            if primary is None:
+                raise teardown_error
+            logger.error(
+                "Writer teardown failed while handling %r: %s",
+                primary,
+                teardown_error,
+            )
 
     return result

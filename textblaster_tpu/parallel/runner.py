@@ -31,6 +31,8 @@ from ..orchestration import (
     read_documents,
 )
 from ..pipeline_builder import build_pipeline_from_config
+from ..resilience.deadletter import DeadLetterSink
+from ..resilience.retry import RetryPolicy
 
 logger = logging.getLogger(__name__)
 
@@ -74,48 +76,64 @@ def run_pipeline(
     device_batch: Optional[int] = None,
     buckets=None,
     quiet: bool = False,
+    errors_file: Optional[str] = None,
 ) -> AggregationResult:
     progress = _Progress(enabled=not quiet)
     read_errors = [0]
 
-    def on_read_error(_err) -> None:
+    # Resilience knobs come from the config; the reader shares the retry
+    # schedule with the device/commit seams.
+    rc = getattr(config, "resilience", None)
+    retry_policy = RetryPolicy.from_config(rc) if rc is not None else None
+
+    deadletter = DeadLetterSink(errors_file) if errors_file is not None else None
+
+    def on_read_error(err) -> None:
         read_errors[0] += 1
+        if deadletter is not None:
+            deadletter.record_read_error(err)
 
     docs = read_documents(
         input_file,
         text_column=text_column,
         id_column=id_column,
         batch_size=read_batch_size,
+        retry_policy=retry_policy,
     )
 
-    if backend == "tpu":
-        import jax
+    try:
+        if backend == "tpu":
+            import jax
 
-        from ..ops.pipeline import process_documents_device
-        from .mesh import data_mesh
+            from ..ops.pipeline import process_documents_device
+            from .mesh import data_mesh
 
-        mesh = data_mesh() if len(jax.devices()) > 1 else None
-        kwargs = {} if buckets is None else {"buckets": buckets}
-        outcomes = process_documents_device(
-            config,
-            docs,
-            device_batch=device_batch,
-            on_read_error=on_read_error,
-            mesh=mesh,
-            **kwargs,
+            mesh = data_mesh() if len(jax.devices()) > 1 else None
+            kwargs = {} if buckets is None else {"buckets": buckets}
+            outcomes = process_documents_device(
+                config,
+                docs,
+                device_batch=device_batch,
+                on_read_error=on_read_error,
+                mesh=mesh,
+                **kwargs,
+            )
+        else:
+            executor = build_pipeline_from_config(config)
+            outcomes = process_documents_host(
+                executor, docs, on_read_error=on_read_error
+            )
+
+        result = aggregate_results_from_stream(
+            outcomes,
+            output_file=output_file,
+            excluded_file=excluded_file,
+            progress=progress.update,
+            deadletter=deadletter,
         )
-    else:
-        executor = build_pipeline_from_config(config)
-        outcomes = process_documents_host(
-            executor, docs, on_read_error=on_read_error
-        )
-
-    result = aggregate_results_from_stream(
-        outcomes,
-        output_file=output_file,
-        excluded_file=excluded_file,
-        progress=progress.update,
-    )
+    finally:
+        if deadletter is not None:
+            deadletter.close()
     progress.finish()
     result.read_errors = read_errors[0]
     return result

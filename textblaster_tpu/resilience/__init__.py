@@ -1,0 +1,53 @@
+"""Fault-tolerant execution layer.
+
+The reference's only fault story is RabbitMQ redelivery plus "swallow hard
+errors and surface a count mismatch" (SURVEY.md §7 quirk #2).  This package
+gives the broker-free executor a real one:
+
+* :mod:`~textblaster_tpu.resilience.retry` — :class:`RetryPolicy` with
+  exponential backoff + jitter, an injectable clock/sleep, and an error
+  classifier that distinguishes transient device/IO faults (retryable) from
+  deterministic pipeline errors (not), applied at the three guarded seams:
+  Parquet row-group reads, device batch execution, checkpoint commit;
+* :mod:`~textblaster_tpu.resilience.breaker` — :class:`CircuitBreaker`
+  behind the device degradation ladder (retry the batch -> split it in half
+  -> rerun on the host oracle), tripping the whole run to the host backend
+  after N consecutive device failures;
+* :mod:`~textblaster_tpu.resilience.faults` — :data:`FAULTS`, a
+  process-global, test-armable :class:`FaultInjector` planted at every seam
+  the retry layer guards, so chaos tests drive real control flow instead of
+  monkeypatching internals;
+* :mod:`~textblaster_tpu.resilience.deadletter` — :class:`DeadLetterSink`,
+  the opt-in ``--errors-file`` Parquet quarantine for Error outcomes and
+  unreadable rows (the default remains the reference's neither-file
+  behavior).
+"""
+
+from .breaker import CircuitBreaker
+from .deadletter import (
+    DEADLETTER_SCHEMA,
+    DeadLetterSink,
+    outcome_row,
+    read_error_row,
+)
+from .faults import FAULTS, FaultInjector
+from .retry import (
+    RetryPolicy,
+    classify_error,
+    is_oom_error,
+    is_retryable_error,
+)
+
+__all__ = [
+    "CircuitBreaker",
+    "DEADLETTER_SCHEMA",
+    "DeadLetterSink",
+    "FAULTS",
+    "FaultInjector",
+    "RetryPolicy",
+    "classify_error",
+    "is_oom_error",
+    "is_retryable_error",
+    "outcome_row",
+    "read_error_row",
+]

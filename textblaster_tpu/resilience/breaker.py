@@ -1,0 +1,70 @@
+"""Circuit breaker for the device execution path.
+
+A device batch that exhausts its whole degradation ladder (retry -> split ->
+host-oracle rerun) still *completes* — the host rung is bit-exact — but each
+such batch costs the full host pipeline.  When the device keeps failing
+batch after batch (dead TPU slice, wedged remote tunnel), paying ladder
+latency per batch is strictly worse than admitting the device is gone:
+after ``threshold`` consecutive failures the breaker trips and the run
+degrades wholesale to the host backend.  The transition is recorded in
+METRICS (``resilience_breaker_trips_total`` counter +
+``resilience_breaker_open`` gauge) and logged once.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from ..utils.metrics import METRICS
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["CircuitBreaker"]
+
+
+class CircuitBreaker:
+    """Trip after ``threshold`` *consecutive* failures; any success resets
+    the streak.  Once open it stays open for the life of the run — the
+    failure modes it guards (lost device, dead tunnel) do not heal
+    mid-stream, and flapping between backends would make outcome attribution
+    meaningless."""
+
+    def __init__(self, threshold: int = 3, name: str = "device") -> None:
+        if threshold < 1:
+            raise ValueError("threshold must be >= 1")
+        self.threshold = threshold
+        self.name = name
+        self._lock = threading.Lock()
+        self._consecutive_failures = 0
+        self._tripped = False
+
+    @property
+    def tripped(self) -> bool:
+        return self._tripped
+
+    @property
+    def consecutive_failures(self) -> int:
+        return self._consecutive_failures
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive_failures = 0
+
+    def record_failure(self, cause: str = "") -> None:
+        with self._lock:
+            if self._tripped:
+                return
+            self._consecutive_failures += 1
+            if self._consecutive_failures < self.threshold:
+                return
+            self._tripped = True
+        METRICS.inc("resilience_breaker_trips_total")
+        METRICS.set("resilience_breaker_open", 1)
+        logger.error(
+            "Circuit breaker '%s' tripped after %d consecutive failures%s; "
+            "degrading to the host backend for the rest of the run.",
+            self.name,
+            self.threshold,
+            f" (last: {cause})" if cause else "",
+        )

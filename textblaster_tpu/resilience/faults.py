@@ -1,0 +1,124 @@
+"""Process-global fault injector for chaos tests.
+
+Every seam the retry layer guards calls :meth:`FaultInjector.fire` with its
+site name; an armed fault raises through the *production* control flow, so
+chaos tests exercise exactly the code paths a real transient failure would —
+no monkeypatching of internals.
+
+Sites planted in this build:
+
+* ``"read.batch"``        — per row-group Parquet fetch
+  (:mod:`textblaster_tpu.io.parquet_reader`);
+* ``"device.execute"``    — per device-batch dispatch
+  (:meth:`textblaster_tpu.ops.pipeline.CompiledPipeline.dispatch_batch`);
+* ``"checkpoint.commit"`` — per checkpoint cursor commit
+  (:meth:`textblaster_tpu.checkpoint.CheckpointState.save`).
+
+The injector is **inert by default**: with nothing armed, :meth:`fire` is a
+single attribute load + falsy check and keeps no per-call state, so
+production paths pay effectively nothing (a tier-1 guard test pins this).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Union
+
+__all__ = ["FaultInjector", "FAULTS"]
+
+ExcSpec = Union[BaseException, Callable[[], BaseException]]
+
+
+@dataclass
+class _ArmedFault:
+    """One armed fault: skip ``after_calls`` fires, then raise ``times``."""
+
+    exc: ExcSpec
+    after_calls: int = 0
+    times: int = 1
+    seen: int = 0
+    raised: int = 0
+
+    def should_raise(self) -> bool:
+        return self.seen > self.after_calls and self.raised < self.times
+
+    def make_exc(self) -> BaseException:
+        if callable(self.exc) and not isinstance(self.exc, BaseException):
+            return self.exc()
+        return self.exc
+
+
+class FaultInjector:
+    """Test-armable fault hook (``inject(site, after_calls=k, exc=...)``).
+
+    ``times`` controls how many consecutive fires raise once triggered —
+    ``times=1`` models a transient blip (first retry succeeds), a large
+    ``times`` models a persistent outage (the ladder degrades rung by rung).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # Falsy when nothing is armed — the only state `fire` consults on
+        # the production fast path.
+        self._sites: Dict[str, List[_ArmedFault]] = {}
+
+    # --- arming (test-side) -------------------------------------------------
+
+    def inject(
+        self,
+        site: str,
+        exc: ExcSpec,
+        after_calls: int = 0,
+        times: int = 1,
+    ) -> None:
+        """Arm ``site``: the ``after_calls+1``-th fire (and the ``times-1``
+        following it) raise ``exc``.  ``exc`` may be an exception instance
+        (re-raised each time) or a zero-arg factory."""
+        if times < 1:
+            raise ValueError("times must be >= 1")
+        if after_calls < 0:
+            raise ValueError("after_calls must be >= 0")
+        with self._lock:
+            self._sites.setdefault(site, []).append(
+                _ArmedFault(exc=exc, after_calls=after_calls, times=times)
+            )
+
+    def reset(self) -> None:
+        """Disarm everything (test teardown)."""
+        with self._lock:
+            self._sites = {}
+
+    def active(self) -> bool:
+        """True if any fault is armed (the tier-1 inertness guard)."""
+        return bool(self._sites)
+
+    def fired(self, site: str) -> int:
+        """How many times ``site``'s armed faults have raised so far."""
+        with self._lock:
+            return sum(f.raised for f in self._sites.get(site, ()))
+
+    # --- production side ----------------------------------------------------
+
+    def fire(self, site: str) -> None:
+        """Called by production seams.  Inert (one falsy check) unless a
+        test armed a fault for ``site``."""
+        if not self._sites:
+            return
+        with self._lock:
+            faults = self._sites.get(site)
+            if not faults:
+                return
+            for f in faults:
+                f.seen += 1
+                if f.should_raise():
+                    f.raised += 1
+                    exc = f.make_exc()
+                    break
+            else:
+                return
+        raise exc
+
+
+#: The process-global injector every guarded seam fires into.
+FAULTS = FaultInjector()

@@ -77,6 +77,54 @@ _SPECS: Dict[str, Tuple[str, str]] = {
         "TokenCounter instances that fell back to the vendored stand-in "
         "tokenizer (counts differ from the hub tokenizer)",
     ),
+    # Resilience layer (no reference equivalent — the reference leans on
+    # RabbitMQ redelivery; see textblaster_tpu/resilience/).
+    "resilience_retries_total": (
+        "counter",
+        "Transient-fault re-attempts across all guarded seams",
+    ),
+    "resilience_retries_read_total": (
+        "counter",
+        "Re-attempts of Parquet row-group reads",
+    ),
+    "resilience_retries_device_total": (
+        "counter",
+        "Re-attempts of device batch execution",
+    ),
+    "resilience_retries_checkpoint_total": (
+        "counter",
+        "Re-attempts of checkpoint cursor commits",
+    ),
+    "resilience_retry_exhausted_total": (
+        "counter",
+        "Guarded operations that spent their whole retry budget",
+    ),
+    "resilience_ladder_split_total": (
+        "counter",
+        "Device batches split in half by the degradation ladder "
+        "(OOM recovery rung)",
+    ),
+    "resilience_ladder_host_total": (
+        "counter",
+        "Documents rerun on the host oracle by the degradation ladder "
+        "after device execution kept failing",
+    ),
+    "resilience_breaker_trips_total": (
+        "counter",
+        "Circuit-breaker trips (device path abandoned for the run)",
+    ),
+    "resilience_breaker_open": (
+        "gauge",
+        "1 while the device circuit breaker is open (run degraded to host)",
+    ),
+    "resilience_quarantined_rows_total": (
+        "counter",
+        "Input rows quarantined because their row group could not be read",
+    ),
+    "deadletter_rows_total": (
+        "counter",
+        "Rows routed to the opt-in dead-letter (--errors-file) sink",
+    ),
 }
 
 
