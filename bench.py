@@ -606,8 +606,9 @@ def main() -> int:
     warmup_s = time.perf_counter() - t0
     _log(f"device warmup (compile+first pass) done in {warmup_s:.1f}s")
 
-    from textblaster_tpu.utils.metrics import METRICS
+    from textblaster_tpu.utils.metrics import METRICS, stage_breakdown, stage_snapshot
 
+    stage_before = stage_snapshot()
     fallbacks_before = METRICS.get("worker_host_fallback_total")
     tails_before = METRICS.get("worker_host_tail_total")
     hazards_before = METRICS.get("worker_fold_hazard_rows_total")
@@ -626,6 +627,10 @@ def main() -> int:
         device_pass_s.append(round(wall, 3))
         device_cpu_frac.append(round((time.process_time() - c0) / wall, 3))
     load_after_dev = os.getloadavg()[0]
+    # Stage breakdown over exactly the 3 timed passes: localizes regressions
+    # to a stage (read/pack/dispatch/device-wait/post/write) and says whether
+    # the run was host- or device-bound.
+    stage_report = stage_breakdown(stage_before)
     dev_elapsed = min(device_pass_s)
     dev_rate = len(run_docs) / dev_elapsed
     _log(
@@ -731,6 +736,10 @@ def main() -> int:
         "platform": jax.default_backend(),
         "warmup_s": round(warmup_s, 1),
         "warmup_compile_s": round(compile_s, 1),
+        # Per-stage wall seconds across the 3 timed passes + the host-bound
+        # vs device-bound verdict (stages overlap, so the sum can exceed
+        # wall time; compare stages to each other).
+        "stage_breakdown": stage_report,
         # Docs the device path re-ran on the host oracle (outliers / table
         # overflow) during the 3 timed passes.  A high rate means the
         # headline number is partly the Python path — it must stay near zero

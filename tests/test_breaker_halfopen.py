@@ -1,0 +1,149 @@
+"""Half-open circuit breaker state machine.
+
+Covers the full closed -> open -> half-open -> closed loop with an
+injectable clock, plus the reopen path (probe failure restarts the
+cooldown) and the latching degenerate case (``cooldown_s=0`` — the
+pre-half-open behavior that the existing resilience tests rely on).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from textblaster_tpu.resilience.breaker import CircuitBreaker
+from textblaster_tpu.utils.metrics import METRICS
+
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _trip(b: CircuitBreaker) -> None:
+    for _ in range(b.threshold):
+        b.record_failure("boom")
+    assert b.state == "open"
+
+
+def test_full_recovery_cycle():
+    clock = FakeClock()
+    b = CircuitBreaker(threshold=2, cooldown_s=10.0, clock=clock)
+    assert b.state == "closed"
+    assert b.allow_request()
+
+    _trip(b)
+    assert b.tripped
+    assert not b.allow_request()
+
+    # Cooldown not yet elapsed.
+    clock.advance(9.9)
+    assert not b.allow_request()
+    assert b.state == "open"
+
+    # Cooldown elapsed: exactly one probe is granted.
+    clock.advance(0.2)
+    probes_before = METRICS.get("resilience_breaker_probe_total")
+    recoveries_before = METRICS.get("resilience_breaker_recoveries_total")
+    assert b.allow_request()
+    assert b.state == "half_open"
+    assert METRICS.get("resilience_breaker_probe_total") == probes_before + 1
+
+    # While the probe is in flight, further traffic is held.
+    assert not b.allow_request()
+    assert not b.allow_request()
+
+    # Probe success closes the breaker and clears the gauge.
+    b.record_success()
+    assert b.state == "closed"
+    assert not b.tripped
+    assert b.allow_request()
+    assert (
+        METRICS.get("resilience_breaker_recoveries_total")
+        == recoveries_before + 1
+    )
+    assert METRICS.get("resilience_breaker_open") == 0
+
+
+def test_probe_failure_reopens_with_fresh_cooldown():
+    clock = FakeClock()
+    b = CircuitBreaker(threshold=1, cooldown_s=5.0, clock=clock)
+    trips_before = METRICS.get("resilience_breaker_trips_total")
+    _trip(b)
+    # A reopen is not a second trip.
+    assert METRICS.get("resilience_breaker_trips_total") == trips_before + 1
+
+    clock.advance(5.0)
+    assert b.allow_request()
+    assert b.state == "half_open"
+    b.record_failure("still dead")
+    assert b.state == "open"
+    assert METRICS.get("resilience_breaker_trips_total") == trips_before + 1
+    assert METRICS.get("resilience_breaker_open") == 1
+
+    # The cooldown restarted at the reopen, not the original trip.
+    clock.advance(4.9)
+    assert not b.allow_request()
+    clock.advance(0.2)
+    assert b.allow_request()
+    b.record_success()
+    assert b.state == "closed"
+
+
+def test_success_while_open_does_not_untrip():
+    # A success recorded while open belongs to a dispatch that predates the
+    # trip (an in-flight batch resolving late) and must not close the breaker.
+    clock = FakeClock()
+    b = CircuitBreaker(threshold=1, cooldown_s=60.0, clock=clock)
+    _trip(b)
+    b.record_success()
+    assert b.tripped
+    assert b.state == "open"
+    assert not b.allow_request()
+    # It does reset the failure streak bookkeeping.
+    assert b.consecutive_failures == 0
+
+
+def test_zero_cooldown_latches_forever():
+    clock = FakeClock()
+    b = CircuitBreaker(threshold=2, cooldown_s=0.0, clock=clock)
+    _trip(b)
+    clock.advance(1e9)
+    assert not b.allow_request()
+    assert b.tripped
+
+
+def test_success_resets_failure_streak_while_closed():
+    b = CircuitBreaker(threshold=3)
+    b.record_failure()
+    b.record_failure()
+    b.record_success()
+    b.record_failure()
+    b.record_failure()
+    assert not b.tripped
+    b.record_failure()
+    assert b.tripped
+
+
+def test_failures_while_open_are_ignored():
+    clock = FakeClock()
+    b = CircuitBreaker(threshold=1, cooldown_s=3.0, clock=clock)
+    trips_before = METRICS.get("resilience_breaker_trips_total")
+    _trip(b)
+    b.record_failure("late ladder failure")
+    b.record_failure("another")
+    assert METRICS.get("resilience_breaker_trips_total") == trips_before + 1
+    clock.advance(3.0)
+    assert b.allow_request()  # cooldown unaffected by the extra failures
+
+
+def test_constructor_validation():
+    with pytest.raises(ValueError):
+        CircuitBreaker(threshold=0)
+    with pytest.raises(ValueError):
+        CircuitBreaker(cooldown_s=-1.0)

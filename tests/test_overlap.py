@@ -1,0 +1,364 @@
+"""Overlapped host pipeline: determinism, chaos at depth, primitives, perf.
+
+The tentpole claim of the overlapped executor is that it moves *only wall
+time*: with the reader thread, pack pool, K-deep device in-flight window,
+and writer thread all enabled, the kept/excluded/dead-letter Parquet files
+are byte-identical to the serial path's (``TEXTBLAST_NO_OVERLAP=1``), and
+the resilience ladder + dead-letter behavior under injected device faults
+is unchanged at depth > 1.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from textblaster_tpu.config.pipeline import parse_pipeline_config
+from textblaster_tpu.parallel.runner import run_pipeline
+from textblaster_tpu.resilience import FAULTS
+from textblaster_tpu.utils.metrics import (
+    METRICS,
+    STAGE_COUNTERS,
+    format_stage_summary,
+    stage_breakdown,
+    stage_snapshot,
+)
+from textblaster_tpu.utils.overlap import ThreadedWriter, prefetch_iter
+
+CONFIG_YAML = """
+pipeline:
+  - type: GopherQualityFilter
+    min_doc_words: 5
+resilience:
+  backoff_base_s: 0.0
+  backoff_max_s: 0.0
+  breaker_threshold: 2
+"""
+
+GOOD = (
+    "This is a sentence with a number of words that is long enough to pass "
+    "the filter easily today."
+)
+BAD = "too short"
+BUCKETS = (512, 2048)
+
+
+def _config(depth=None):
+    config = parse_pipeline_config(CONFIG_YAML)
+    if depth is not None:
+        config.overlap.pipeline_depth = depth
+    return config
+
+
+def _write_corpus(path, n=420):
+    """Deterministic mixed corpus: pass/fail docs, empties, astral text, and
+    over-length rows (> largest bucket) that take the host-fallback route."""
+    texts = []
+    for i in range(n):
+        k = i % 7
+        if k == 0:
+            texts.append(BAD)
+        elif k == 1:
+            texts.append("")
+        elif k == 2:
+            texts.append(GOOD + " 😀 blåbærgrød " + "é" * (i % 11))
+        elif k == 3:
+            # Past the largest bucket's admission edge: host fallback.
+            texts.append((GOOD + " ") * 25)
+        else:
+            texts.append(GOOD + f" extra words number {i}.")
+    assert any(len(t) > BUCKETS[-1] - 4 for t in texts)
+    pq.write_table(
+        pa.table({"id": [f"doc-{i}" for i in range(n)], "text": texts}), path
+    )
+
+
+def _run(tmp_path, tag, config, inp, n_docs=None):
+    kept = str(tmp_path / f"kept-{tag}.parquet")
+    excl = str(tmp_path / f"excl-{tag}.parquet")
+    errs = str(tmp_path / f"errs-{tag}.parquet")
+    result = run_pipeline(
+        config=config,
+        input_file=inp,
+        output_file=kept,
+        excluded_file=excl,
+        backend="tpu",
+        read_batch_size=64,
+        device_batch=32,
+        buckets=BUCKETS,
+        quiet=True,
+        errors_file=errs,
+    )
+    if n_docs is not None:
+        assert result.received == n_docs
+    return kept, excl, errs, result
+
+
+def _table_key(path):
+    t = pq.read_table(path).to_pylist()
+    rows = {r["id"]: r for r in t}
+    assert len(rows) == len(t), "duplicate ids in output"
+    return rows
+
+
+# --- determinism: serial vs overlapped, byte for byte -----------------------
+
+
+def test_serial_vs_overlapped_byte_identical(tmp_path, monkeypatch):
+    inp = str(tmp_path / "in.parquet")
+    n = 420
+    _write_corpus(inp, n)
+
+    monkeypatch.setenv("TEXTBLAST_NO_OVERLAP", "1")
+    serial = _run(tmp_path, "serial", _config(), inp, n)
+
+    monkeypatch.delenv("TEXTBLAST_NO_OVERLAP")
+    over = _run(tmp_path, "overlap", _config(depth=3), inp, n)
+
+    assert serial[3].success == over[3].success
+    assert serial[3].filtered == over[3].filtered
+    assert serial[3].errors == over[3].errors
+    for s_path, o_path, what in zip(serial[:3], over[:3],
+                                    ("kept", "excluded", "errors")):
+        s_bytes = open(s_path, "rb").read()
+        o_bytes = open(o_path, "rb").read()
+        assert s_bytes == o_bytes, f"{what} Parquet differs serial-vs-overlap"
+    # The corpus actually exercised every outcome class.
+    assert serial[3].success > 0 and serial[3].filtered > 0
+
+
+def test_depth_one_overlap_matches_deeper_window(tmp_path):
+    # The in-flight window's FIFO drain order must be depth-invariant, not
+    # just on/off-invariant.
+    inp = str(tmp_path / "in.parquet")
+    _write_corpus(inp, 200)
+    d1 = _run(tmp_path, "d1", _config(depth=1), inp, 200)
+    d4 = _run(tmp_path, "d4", _config(depth=4), inp, 200)
+    for a, b in zip(d1[:3], d4[:3]):
+        assert open(a, "rb").read() == open(b, "rb").read()
+
+
+# --- chaos at depth > 1 ------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_device_outage_at_depth_matches_fault_free(tmp_path):
+    inp = str(tmp_path / "in.parquet")
+    n = 300
+    _write_corpus(inp, n)
+
+    clean = _run(tmp_path, "clean", _config(depth=3), inp, n)
+
+    # Persistent device outage with three batches in flight: every batch
+    # must fall down the ladder to the bit-exact host rung, the breaker must
+    # trip exactly once, and the dead-letter file must stay free of
+    # device-fault rows (degradation is not an error outcome).
+    FAULTS.inject("device.execute", OSError("chaos: slice gone"), times=10_000)
+    before = {
+        name: METRICS.get(name)
+        for name in (
+            "resilience_ladder_host_total",
+            "resilience_breaker_trips_total",
+            "deadletter_rows_total",
+        )
+    }
+    faulty = _run(tmp_path, "faulty", _config(depth=3), inp, n)
+    FAULTS.reset()
+
+    assert _table_key(clean[0]) == _table_key(faulty[0])
+    assert _table_key(clean[1]) == _table_key(faulty[1])
+    assert _table_key(clean[2]) == _table_key(faulty[2]) == {}
+    assert (clean[3].success, clean[3].filtered, clean[3].errors) == (
+        faulty[3].success, faulty[3].filtered, faulty[3].errors,
+    )
+    assert METRICS.get("resilience_ladder_host_total") > before[
+        "resilience_ladder_host_total"
+    ]
+    assert (
+        METRICS.get("resilience_breaker_trips_total")
+        == before["resilience_breaker_trips_total"] + 1
+    )
+    assert (
+        METRICS.get("deadletter_rows_total") == before["deadletter_rows_total"]
+    )
+
+
+@pytest.mark.chaos
+def test_transient_device_faults_at_depth_recover(tmp_path):
+    inp = str(tmp_path / "in.parquet")
+    n = 300
+    _write_corpus(inp, n)
+    clean = _run(tmp_path, "clean2", _config(depth=3), inp, n)
+
+    # A couple of transient faults land on whichever in-flight batches are
+    # dispatching; each recovers inside the ladder without tripping the
+    # breaker (threshold 2 needs *consecutive* batch failures to stick, and
+    # the ladder completes each batch).
+    trips_before = METRICS.get("resilience_breaker_trips_total")
+    FAULTS.inject("device.execute", OSError("chaos: blip"), times=2)
+    faulty = _run(tmp_path, "faulty2", _config(depth=3), inp, n)
+    assert FAULTS.fired("device.execute") == 2
+
+    assert _table_key(clean[0]) == _table_key(faulty[0])
+    assert _table_key(clean[1]) == _table_key(faulty[1])
+    assert METRICS.get("resilience_breaker_trips_total") == trips_before
+
+
+# --- overlap primitives ------------------------------------------------------
+
+
+def test_prefetch_iter_preserves_order_and_exhausts():
+    items = list(range(1000))
+    out = list(prefetch_iter(iter(items), depth=3, block=17))
+    assert out == items
+
+
+def test_prefetch_iter_forwards_exception_in_order():
+    def source():
+        yield 1
+        yield 2
+        raise ValueError("reader died")
+
+    it = prefetch_iter(source(), depth=2, block=1)
+    assert next(it) == 1
+    assert next(it) == 2
+    with pytest.raises(ValueError, match="reader died"):
+        next(it)
+    with pytest.raises(StopIteration):
+        next(it)
+
+
+def test_prefetch_iter_close_unblocks_producer():
+    produced = []
+
+    def slow_infinite():
+        i = 0
+        while True:
+            produced.append(i)
+            yield i
+            i += 1
+
+    it = prefetch_iter(slow_infinite(), depth=1, block=1)
+    assert next(it) == 0
+    it.close()  # must not hang on the blocked producer
+    time.sleep(0.05)
+    n = len(produced)
+    time.sleep(0.2)
+    assert len(produced) == n, "producer thread kept running after close()"
+
+
+class _RecordingWriter:
+    def __init__(self, fail_on=None):
+        self.batches = []
+        self.closed = False
+        self.fail_on = fail_on
+
+    def write_batch(self, outcomes):
+        if self.fail_on is not None and len(self.batches) == self.fail_on:
+            raise OSError("disk full")
+        self.batches.append(list(outcomes))
+
+    def close(self):
+        self.closed = True
+
+
+def test_threaded_writer_fifo_and_copy_on_enqueue():
+    inner = _RecordingWriter()
+    w = ThreadedWriter(inner, max_queue=4)
+    buf = []
+    for i in range(20):
+        buf.append(i)
+        w.write_batch(buf)
+        buf.clear()  # callers reuse their buffer; the wrapper must copy
+    w.close()
+    assert inner.batches == [[i] for i in range(20)]
+    assert inner.closed
+
+
+def test_threaded_writer_error_surfaces_and_inner_still_closes():
+    inner = _RecordingWriter(fail_on=1)
+    w = ThreadedWriter(inner, max_queue=2)
+    with pytest.raises(OSError, match="disk full"):
+        for i in range(50):
+            w.write_batch([i])
+            time.sleep(0.01)
+        w.close()
+    # A failed writer refuses further work...
+    with pytest.raises(RuntimeError):
+        w.write_batch([99])
+    # ...and the inner writer was (or can still be) closed.
+    if not inner.closed:
+        inner.close()
+    assert inner.batches == [[0]]
+
+
+def test_threaded_writer_error_at_close():
+    inner = _RecordingWriter(fail_on=0)
+    w = ThreadedWriter(inner, max_queue=8)
+    w.write_batch([1])
+    with pytest.raises(OSError, match="disk full"):
+        w.close()
+    assert inner.closed  # close() still closes the inner writer
+
+
+def test_threaded_writer_proxies_attributes():
+    inner = _RecordingWriter()
+    inner.rows_written = 7
+    w = ThreadedWriter(inner)
+    assert w.rows_written == 7
+    w.close()
+
+
+# --- stage wall-time metrics -------------------------------------------------
+
+
+def test_stage_counters_populate_and_verdict_is_sane(tmp_path):
+    inp = str(tmp_path / "in.parquet")
+    _write_corpus(inp, 150)
+    before = stage_snapshot()
+    _run(tmp_path, "stages", _config(), inp, 150)
+    report = stage_breakdown(before)
+    for name in ("stage_read_seconds", "stage_pack_seconds",
+                 "stage_dispatch_seconds", "stage_write_seconds"):
+        assert report["stages_s"][name] > 0.0, f"{name} never accumulated"
+    assert report["verdict"] in ("host-bound", "device-bound", "balanced")
+    assert report["host_s"] >= 0.0 and report["device_s"] >= 0.0
+    summary = format_stage_summary(before)
+    assert "Stage breakdown" in summary and report["verdict"] in summary
+    assert set(report["stages_s"]) == set(STAGE_COUNTERS)
+
+
+# --- perf smoke --------------------------------------------------------------
+
+
+@pytest.mark.perf
+@pytest.mark.slow
+def test_overlapped_not_slower_than_serial(tmp_path, monkeypatch):
+    """Overlap must beat or tie the serial path (generous tolerance: CI
+    machines are noisy and the CPU backend leaves little device time to
+    hide host work behind)."""
+    inp = str(tmp_path / "in.parquet")
+    n = 2000
+    _write_corpus(inp, n)
+
+    # Warm the compile cache so neither timed run pays jit costs.
+    _run(tmp_path, "warm", _config(), inp, n)
+
+    monkeypatch.setenv("TEXTBLAST_NO_OVERLAP", "1")
+    t0 = time.perf_counter()
+    _run(tmp_path, "pserial", _config(), inp, n)
+    serial_s = time.perf_counter() - t0
+
+    monkeypatch.delenv("TEXTBLAST_NO_OVERLAP")
+    t0 = time.perf_counter()
+    _run(tmp_path, "poverlap", _config(depth=2), inp, n)
+    overlap_s = time.perf_counter() - t0
+
+    assert overlap_s <= serial_s * 1.35 + 0.5, (
+        f"overlapped path regressed: {overlap_s:.2f}s vs serial "
+        f"{serial_s:.2f}s"
+    )

@@ -1,0 +1,141 @@
+"""Property tests for the vectorized bulk packer.
+
+``pack_documents`` (one concatenated ``encode("utf-32-le")`` + offset-based
+scatter per batch) must be byte-identical to the per-document reference
+``pack_documents_loop`` on every input: empty documents, astral codepoints,
+bucket-margin edge lengths, empty batches, full batches.  The device path's
+correctness rests on this equivalence — every downstream parity suite packs
+through it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from textblaster_tpu.data_model import TextDocument
+from textblaster_tpu.ops.packing import (
+    DEFAULT_BUCKETS,
+    PACK_MARGIN,
+    iter_packed_batches,
+    pack_documents,
+    pack_documents_loop,
+)
+
+
+def _docs(texts):
+    return [
+        TextDocument(id=f"d{i}", content=t, source="test")
+        for i, t in enumerate(texts)
+    ]
+
+
+def _assert_identical(docs, batch_size, max_len):
+    a = pack_documents(docs, batch_size=batch_size, max_len=max_len)
+    b = pack_documents_loop(docs, batch_size=batch_size, max_len=max_len)
+    np.testing.assert_array_equal(a.cps, b.cps)
+    np.testing.assert_array_equal(a.lengths, b.lengths)
+    np.testing.assert_array_equal(a.valid, b.valid)
+    assert a.cps.dtype == b.cps.dtype == np.int32
+    assert a.lengths.dtype == b.lengths.dtype == np.int32
+    assert [d.id for d in a.docs] == [d.id for d in b.docs]
+
+
+# Deliberately nasty corpus pieces: empties, BMP boundary chars, astral
+# (supplementary-plane) codepoints, combining marks, newlines, NUL.
+_PIECES = [
+    "",
+    "a",
+    "\x00",
+    "hej verden",
+    "æøå ÆØÅ",
+    "日本語のテキストです",
+    "￿￾",          # top of the BMP
+    "\U00010000",            # first astral codepoint
+    "😀🌍🎉",                  # emoji (astral)
+    "éé",        # combining acute
+    "line\nbreaks\nhere\n",
+    "мир тесен",
+]
+
+
+def test_fuzz_equivalence_against_loop_packer():
+    rng = np.random.default_rng(4242)
+    for _ in range(300):
+        n = int(rng.integers(0, 9))
+        texts = []
+        for _ in range(n):
+            k = int(rng.integers(1, 5))
+            idx = rng.integers(0, len(_PIECES), size=k)
+            rep = int(rng.integers(1, 8))
+            texts.append("".join(_PIECES[i] for i in idx) * rep)
+        batch_size = int(rng.choice([8, 16, 32]))
+        max_len = int(rng.choice([64, 512]))
+        texts = [t[:max_len] for t in texts]
+        _assert_identical(_docs(texts), batch_size, max_len)
+
+
+def test_empty_batch_and_all_empty_docs():
+    _assert_identical([], 8, 64)
+    _assert_identical(_docs(["", "", ""]), 8, 64)
+    # Padding rows must be exactly zero with valid=False.
+    a = pack_documents(_docs(["", "ab"]), batch_size=4, max_len=16)
+    assert a.lengths.tolist() == [0, 2, 0, 0]
+    assert a.valid.tolist() == [True, True, False, False]
+    assert not a.cps[2:].any()
+
+
+def test_bucket_margin_edges():
+    # Lengths at and around every bucket's admission edge (b - PACK_MARGIN),
+    # including a doc exactly at max_len capacity.
+    for b in (64, 512):
+        edge = b - PACK_MARGIN
+        texts = ["x" * edge, "y" * (edge - 1), "z" * b, "w" * 1]
+        _assert_identical(_docs(texts), 8, b)
+
+
+def test_astral_codepoints_roundtrip_exactly():
+    texts = ["😀", "a😀b", "\U0010ffff" * 3, "mixed 日本 😀 text"]
+    a = pack_documents(_docs(texts), batch_size=4, max_len=32)
+    for row, t in enumerate(texts):
+        got = a.cps[row, : a.lengths[row]].tolist()
+        assert got == [ord(c) for c in t]
+        assert a.lengths[row] == len(t)
+
+
+def test_full_batch_exactly():
+    texts = [f"doc number {i} with some text." for i in range(16)]
+    _assert_identical(_docs(texts), 16, 64)
+
+
+def test_over_length_doc_still_asserts():
+    docs = _docs(["x" * 65])
+    with pytest.raises(AssertionError):
+        pack_documents(docs, batch_size=4, max_len=64)
+    with pytest.raises(AssertionError):
+        pack_documents_loop(docs, batch_size=4, max_len=64)
+
+
+def test_iter_packed_batches_pack_fn_receives_all_call_sites():
+    # Both the main flush and the leftover-group flush must go through the
+    # injected pack_fn (the overlapped pipeline routes it to a thread pool).
+    calls = []
+
+    def spy(docs, batch_size, max_len):
+        calls.append((len(docs), batch_size, max_len))
+        return pack_documents(docs, batch_size=batch_size, max_len=max_len)
+
+    docs = _docs(["hello world " * 4] * 10)
+    out = list(
+        iter_packed_batches(
+            iter(docs), batch_size=4, buckets=(64, 512), pack_fn=spy
+        )
+    )
+    batches = [b for b, _ in out if b is not None]
+    assert batches and calls
+    assert sum(c[0] for c in calls) == len(docs)
+
+
+def test_default_buckets_unchanged():
+    # The packer rewrite must not touch the bucket contract.
+    assert DEFAULT_BUCKETS == (512, 2048, 8192, 32768, 65536)

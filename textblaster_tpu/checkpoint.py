@@ -324,6 +324,24 @@ def run_checkpointed(
         skip_rows=state.rows_consumed,
         retry_policy=retry_policy,
     )
+    # Overlapped read-ahead (device backend): the reader thread only runs
+    # AHEAD of consumption — `rows_consumed` still counts exactly the items
+    # drained into chunks, part writes stay synchronous at chunk boundaries,
+    # and commit semantics are untouched.
+    raw_close = None
+    oc = getattr(config, "overlap", None)
+    if (
+        backend == "tpu"
+        and oc is not None
+        and oc.enabled
+        and os.environ.get("TEXTBLAST_NO_OVERLAP") != "1"
+    ):
+        from .utils.overlap import prefetch_iter
+
+        raw = prefetch_iter(
+            raw, depth=oc.read_ahead, block=max(64, read_batch_size // 4)
+        )
+        raw_close = raw.close
 
     # Chunk processor: host executor or a single CompiledPipeline reused
     # across chunks (compiled programs cached between calls).
@@ -421,6 +439,9 @@ def run_checkpointed(
         out_parts.abort()
         excl_parts.abort()
         raise
+    finally:
+        if raw_close is not None:
+            raw_close()  # stop the read-ahead thread on every exit path
 
     # Finalize: single kept/excluded pair with the reference's schema.  Only
     # artifacts this subsystem created are deleted — the directory itself is

@@ -69,6 +69,18 @@ def build_parser() -> argparse.ArgumentParser:
                           "fallback.  Default: the built-in long-doc set.")
     run.add_argument("--device-batch", type=int, default=None,
                      help="Documents per device batch (tpu backend)")
+    run.add_argument("--pipeline-depth", type=int, default=None,
+                     help="Device batches kept in flight by the overlapped "
+                          "host pipeline (default: the config's "
+                          "overlap.pipeline_depth, 2).  Higher values hide "
+                          "more host time behind device compute at the cost "
+                          "of one packed batch of host memory each")
+    run.add_argument("--no-overlap", action="store_true",
+                     help="Disable the overlapped host pipeline (reader "
+                          "thread, pack pool, in-flight window, writer "
+                          "thread) and run the serial path.  Outputs are "
+                          "byte-identical either way; this is the "
+                          "escape hatch and A/B baseline")
     run.add_argument("--metrics-port", type=int, default=None,
                      help="Port for the Prometheus metrics HTTP endpoint")
     run.add_argument("--quiet", action="store_true", help="Suppress progress output")
@@ -139,6 +151,15 @@ def _cmd_run(args: argparse.Namespace) -> int:
     except PipelineError as e:
         print(f"Failed to load pipeline config: {e}", file=sys.stderr)
         return 1
+
+    if args.no_overlap:
+        config.overlap.enabled = False
+    if args.pipeline_depth is not None:
+        if args.pipeline_depth < 1:
+            print(f"Invalid --pipeline-depth value: {args.pipeline_depth}",
+                  file=sys.stderr)
+            return 1
+        config.overlap.pipeline_depth = args.pipeline_depth
 
     buckets = None
     if args.buckets:
@@ -277,6 +298,11 @@ def _cmd_run(args: argparse.Namespace) -> int:
     if result.read_errors:
         print(f"Warning: {result.read_errors} rows could not be read.",
               file=sys.stderr)
+    if not args.quiet:
+        from .utils.metrics import STAGE_COUNTERS, format_stage_summary
+
+        if any(METRICS.get(name) > 0 for name in STAGE_COUNTERS):
+            print(format_stage_summary(), file=sys.stderr)
     return 0
 
 

@@ -27,6 +27,7 @@ __all__ = [
     "FineWebQualityFilterParams",
     "TokenCounterParams",
     "ResilienceConfig",
+    "OverlapConfig",
     "load_pipeline_config",
     "parse_pipeline_config",
 ]
@@ -317,6 +318,8 @@ class ResilienceConfig:
     backoff_multiplier: float = 2.0
     jitter: float = 0.5           # each delay widened by up to this fraction
     breaker_threshold: int = 3    # consecutive device failures before the trip
+    breaker_cooldown_s: float = 30.0  # open time before a half-open probe;
+    #                                   0 latches open for the run's life
     split_retry: bool = True      # enable the split-in-half OOM rung
 
     def validate(self) -> None:
@@ -343,6 +346,11 @@ class ResilienceConfig:
                 "ResilienceConfig: breaker_threshold must be >= 1, "
                 f"got {self.breaker_threshold}"
             )
+        if self.breaker_cooldown_s < 0.0:
+            raise ConfigValidationError(
+                "ResilienceConfig: breaker_cooldown_s must be non-negative, "
+                f"got {self.breaker_cooldown_s}"
+            )
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "ResilienceConfig":
@@ -355,6 +363,49 @@ class ResilienceConfig:
             return cls(**fields_d)
         except TypeError as e:
             raise ConfigError(f"invalid resilience config: {e}") from e
+
+
+@dataclass
+class OverlapConfig:
+    """Host-pipeline overlap knobs for the device backend (no reference
+    equivalent — the reference's workers are synchronous per message).
+
+    Parsed from an optional top-level ``overlap:`` mapping in the pipeline
+    YAML.  Like ``resilience``, excluded from the checkpoint config
+    fingerprint (checkpoint.py hashes ``config.pipeline`` only): overlap
+    changes wall time, never outcomes, so tuning it must not invalidate a
+    resumable run.
+    """
+
+    enabled: bool = True       # --no-overlap forces False
+    pipeline_depth: int = 2    # device batches kept in flight (1 = serial)
+    pack_workers: int = 2      # threads over the GIL-releasing pack work
+    read_ahead: int = 4        # Parquet read-ahead queue, in read batches
+    write_queue: int = 8       # writer-thread queue, in outcome batches
+
+    def validate(self) -> None:
+        for name, val, lo in (
+            ("pipeline_depth", self.pipeline_depth, 1),
+            ("pack_workers", self.pack_workers, 1),
+            ("read_ahead", self.read_ahead, 1),
+            ("write_queue", self.write_queue, 1),
+        ):
+            if val < lo:
+                raise ConfigValidationError(
+                    f"OverlapConfig: {name} must be >= {lo}, got {val}"
+                )
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "OverlapConfig":
+        if not isinstance(d, dict):
+            raise ConfigError("`overlap` must be a mapping")
+        known = set(cls.__dataclass_fields__)
+        # serde-without-deny_unknown_fields parity: extra keys are ignored.
+        fields_d = {k: v for k, v in d.items() if k in known}
+        try:
+            return cls(**fields_d)
+        except TypeError as e:
+            raise ConfigError(f"invalid overlap config: {e}") from e
 
 
 @dataclass
@@ -413,11 +464,13 @@ class PipelineConfig:
 
     pipeline: List[StepConfig]
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    overlap: OverlapConfig = field(default_factory=OverlapConfig)
 
     def validate(self) -> None:
         for step in self.pipeline:
             step.validate()
         self.resilience.validate()
+        self.overlap.validate()
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "PipelineConfig":
@@ -427,12 +480,18 @@ class PipelineConfig:
         if steps_raw is None or not isinstance(steps_raw, list):
             raise ConfigError("`pipeline` must be a list of steps")
         resilience_raw = d.get("resilience")
+        overlap_raw = d.get("overlap")
         return cls(
             pipeline=[StepConfig.from_dict(s) for s in steps_raw],
             resilience=(
                 ResilienceConfig.from_dict(resilience_raw)
                 if resilience_raw is not None
                 else ResilienceConfig()
+            ),
+            overlap=(
+                OverlapConfig.from_dict(overlap_raw)
+                if overlap_raw is not None
+                else OverlapConfig()
             ),
         )
 

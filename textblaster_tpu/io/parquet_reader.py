@@ -135,13 +135,19 @@ class ParquetReader(BaseReader):
         """One row group off disk — the guarded read seam.  The fault site
         fires *inside* the retried callable so chaos tests drive the retry
         layer through real control flow."""
+        import time
+
         policy = self.retry_policy or _default_read_retry()
 
         def fetch() -> pa.Table:
             FAULTS.fire("read.batch")
             return pf.read_row_group(group)
 
-        return policy.run(fetch, seam="read")
+        t0 = time.perf_counter()
+        try:
+            return policy.run(fetch, seam="read")
+        finally:
+            METRICS.inc("stage_read_seconds", time.perf_counter() - t0)
 
     def _iter_group_batches(
         self, skip_rows: int = 0, on_quarantine=None
@@ -236,75 +242,92 @@ class ParquetReader(BaseReader):
                         f"'{self.config.path}' unreadable: {q.error}"
                     )
                 continue
-            cols = {name: batch.column(i) for i, name in enumerate(batch.schema.names)}
-            text_col = cols[self.config.text_column]
-            id_col = cols[self.config.id_column]
-            n = batch.num_rows
+            # Decode the whole batch into a list before yielding: the decode
+            # wall time must exclude consumer time (a generator suspends at
+            # every yield), or the read-stage counter would absorb the rest
+            # of the pipeline.
+            import time
 
-            source_col = cols.get("source") if has["source"] else None
-            added_col = cols.get("added") if has["added"] else None
-            created_col = cols.get("created") if has["created"] else None
-            metadata_col = cols.get("metadata") if has["metadata"] else None
+            t0 = time.perf_counter()
+            items = self._decode_batch(batch, has)
+            METRICS.inc("stage_read_seconds", time.perf_counter() - t0)
+            yield from items
 
-            for i in range(n):
-                if not text_col[i].is_valid:
-                    yield UnexpectedError(
-                        f"Row {i} has null text column '{self.config.text_column}'"
+    def _decode_batch(
+        self, batch: pa.RecordBatch, has: dict
+    ) -> list:
+        """Arrow record batch -> list of documents / per-row errors."""
+        items: list = []
+        cols = {name: batch.column(i) for i, name in enumerate(batch.schema.names)}
+        text_col = cols[self.config.text_column]
+        id_col = cols[self.config.id_column]
+        n = batch.num_rows
+
+        source_col = cols.get("source") if has["source"] else None
+        added_col = cols.get("added") if has["added"] else None
+        created_col = cols.get("created") if has["created"] else None
+        metadata_col = cols.get("metadata") if has["metadata"] else None
+
+        for i in range(n):
+            if not text_col[i].is_valid:
+                items.append(UnexpectedError(
+                    f"Row {i} has null text column '{self.config.text_column}'"
+                ))
+                continue
+            if not id_col[i].is_valid:
+                items.append(UnexpectedError(
+                    f"Row {i} has null id column '{self.config.id_column}'"
+                ))
+                continue
+
+            doc_id = id_col[i].as_py()
+            # HTML-entity decode at ingest (rs:177-179).
+            content = html.unescape(text_col[i].as_py())
+
+            source = None
+            if source_col is not None and source_col[i].is_valid:
+                source = source_col[i].as_py()
+            if source is None:
+                source = self.config.path  # fallback (rs:181-190)
+
+            added = None
+            if added_col is not None and added_col[i].is_valid:
+                added = _to_date(added_col[i].as_py())
+
+            created = None
+            if created_col is not None and created_col[i].is_valid:
+                cell = created_col[i].as_py()
+                if isinstance(cell, dict) and len(cell) >= 2:
+                    vals = list(cell.values())
+                    start = _to_datetime(vals[0])
+                    end = _to_datetime(vals[1])
+                    if start is not None and end is not None:
+                        created = (start, end)
+                else:
+                    logger.warning("'created' column is not a struct.")
+
+            metadata = {}
+            if metadata_col is not None and metadata_col[i].is_valid:
+                raw = metadata_col[i].as_py()
+                try:
+                    parsed = json.loads(raw)
+                    metadata = (
+                        {str(k): str(v) for k, v in parsed.items()}
+                        if isinstance(parsed, dict)
+                        else {}
                     )
-                    continue
-                if not id_col[i].is_valid:
-                    yield UnexpectedError(
-                        f"Row {i} has null id column '{self.config.id_column}'"
+                except (json.JSONDecodeError, AttributeError) as e:
+                    logger.warning(
+                        "Failed to parse metadata JSON. id=%s err=%s", doc_id, e
                     )
-                    continue
+                    metadata = {}
 
-                doc_id = id_col[i].as_py()
-                # HTML-entity decode at ingest (rs:177-179).
-                content = html.unescape(text_col[i].as_py())
-
-                source = None
-                if source_col is not None and source_col[i].is_valid:
-                    source = source_col[i].as_py()
-                if source is None:
-                    source = self.config.path  # fallback (rs:181-190)
-
-                added = None
-                if added_col is not None and added_col[i].is_valid:
-                    added = _to_date(added_col[i].as_py())
-
-                created = None
-                if created_col is not None and created_col[i].is_valid:
-                    cell = created_col[i].as_py()
-                    if isinstance(cell, dict) and len(cell) >= 2:
-                        vals = list(cell.values())
-                        start = _to_datetime(vals[0])
-                        end = _to_datetime(vals[1])
-                        if start is not None and end is not None:
-                            created = (start, end)
-                    else:
-                        logger.warning("'created' column is not a struct.")
-
-                metadata = {}
-                if metadata_col is not None and metadata_col[i].is_valid:
-                    raw = metadata_col[i].as_py()
-                    try:
-                        parsed = json.loads(raw)
-                        metadata = (
-                            {str(k): str(v) for k, v in parsed.items()}
-                            if isinstance(parsed, dict)
-                            else {}
-                        )
-                    except (json.JSONDecodeError, AttributeError) as e:
-                        logger.warning(
-                            "Failed to parse metadata JSON. id=%s err=%s", doc_id, e
-                        )
-                        metadata = {}
-
-                yield TextDocument(
-                    id=str(doc_id),
-                    content=content,
-                    source=str(source),
-                    added=added,
-                    created=created,
-                    metadata=metadata,
-                )
+            items.append(TextDocument(
+                id=str(doc_id),
+                content=content,
+                source=str(source),
+                added=added,
+                created=created,
+                metadata=metadata,
+            ))
+        return items

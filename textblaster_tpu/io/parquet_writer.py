@@ -13,6 +13,7 @@ Re-implementation of ``ParquetWriter``
 from __future__ import annotations
 
 import json
+import time
 from typing import List, Optional, Sequence
 
 import pyarrow as pa
@@ -20,6 +21,7 @@ import pyarrow.parquet as pq
 
 from ..data_model import TextDocument
 from ..errors import ParquetError
+from ..utils.metrics import METRICS
 from .base import BaseWriter
 
 __all__ = ["ParquetWriter", "OUTPUT_SCHEMA"]
@@ -57,6 +59,16 @@ class ParquetWriter(BaseWriter):
     def write_batch(self, documents: Sequence[TextDocument]) -> None:
         if not documents:
             return
+        t0 = time.perf_counter()
+        try:
+            self._write_batch_inner(documents)
+        finally:
+            # Timed here (not in callers) so every write path — runner,
+            # checkpoint parts, the threaded writer — lands in the stage
+            # counter exactly once.
+            METRICS.inc("stage_write_seconds", time.perf_counter() - t0)
+
+    def _write_batch_inner(self, documents: Sequence[TextDocument]) -> None:
         ids: List[str] = []
         sources: List[str] = []
         texts: List[str] = []

@@ -23,6 +23,7 @@ the host oracle — the outlier path SURVEY.md §5 calls for.
 from __future__ import annotations
 
 import logging
+from collections import deque
 from functools import lru_cache, partial
 from typing import Callable, Dict, Iterable, Iterator, List, Optional, Tuple, Union
 
@@ -30,7 +31,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..config.pipeline import PipelineConfig, ResilienceConfig, StepConfig
+from ..config.pipeline import (
+    OverlapConfig,
+    PipelineConfig,
+    ResilienceConfig,
+    StepConfig,
+)
 from ..data_model import ProcessingOutcome, TextDocument
 from ..errors import PipelineError, RetryExhaustedError
 from ..filters.c4_quality import CITATION_RE
@@ -44,6 +50,7 @@ from ..resilience.breaker import CircuitBreaker
 from ..resilience.faults import FAULTS
 from ..resilience.retry import RetryPolicy
 from ..utils.metrics import METRICS
+from ..utils.overlap import prefetch_iter
 from .badwords import badwords_matches_multi
 from .langid_tpu import langid_scores
 from .packing import (
@@ -77,6 +84,11 @@ _DEVICE_STEPS = {
 }
 
 _CJK_BADWORDS_LANGS = ("ja", "th", "zh")  # c4_filters.rs:70
+
+#: Window sentinel: the breaker refused this batch's dispatch — the drain
+#: sends it straight to the host rung without recording a breaker failure
+#: (the device was never asked, so there is nothing new to count).
+_BREAKER_OPEN = object()
 
 
 def device_step_types() -> frozenset:
@@ -377,8 +389,17 @@ class CompiledPipeline:
         # consecutive batches fell all the way to the host rung.
         rc = getattr(config, "resilience", None) or ResilienceConfig()
         self._retry = RetryPolicy.from_config(rc)
-        self._breaker = CircuitBreaker(rc.breaker_threshold)
+        self._breaker = CircuitBreaker(
+            rc.breaker_threshold,
+            cooldown_s=getattr(rc, "breaker_cooldown_s", 0.0),
+        )
         self._split_retry = rc.split_retry
+
+        # Overlapped host pipeline (see process_chunk): depth of the device
+        # in-flight window and the pack-stage thread pool.  Mesh runs stay
+        # serial (lockstep dispatch must not reorder across hosts).
+        self._overlap = getattr(config, "overlap", None) or OverlapConfig()
+        self._pack_pool_obj = None
 
     def _badwords_host_step(self, idx: int):
         """The real host C4BadWordsFilter for device step ``idx`` — runs only
@@ -555,6 +576,14 @@ class CompiledPipeline:
                 ),
                 out_shardings=out_sharding,
             )
+        if jax.default_backend() in ("tpu", "axon"):
+            # Each dispatch uploads fresh numpy arrays, so the input buffers
+            # are never reused host-side: donating them lets XLA alias the
+            # [B, L] codepoint upload into scratch instead of holding both
+            # live — with a K-deep in-flight window the biggest buffer would
+            # otherwise exist K+1 times.  CPU stays undonated (XLA:CPU often
+            # can't use the donation and warns per call).
+            return jax.jit(fn, donate_argnums=(0, 1))
         return jax.jit(fn)
 
     def _fn_for(
@@ -1186,6 +1215,8 @@ class CompiledPipeline:
         from scratch.  Returns host-side numpy stats (``jax.device_get`` on
         numpy is identity, so ``assemble_phase`` takes them unchanged).
         """
+        import time
+
         first = [inflight]
 
         def attempt() -> Dict[str, np.ndarray]:
@@ -1193,7 +1224,16 @@ class CompiledPipeline:
             first[0] = None
             if stats is None:
                 stats = self.dispatch_batch(batch, phase)
-            return jax.device_get(stats)
+            t0 = time.perf_counter()
+            try:
+                return jax.device_get(stats)
+            finally:
+                # Time blocked on device results (transfer + any compute not
+                # yet finished).  Identity-fast for already-numpy stats, so
+                # re-attempts after a host-side fetch don't double-count.
+                METRICS.inc(
+                    "stage_device_wait_seconds", time.perf_counter() - t0
+                )
 
         return self._retry.run(attempt, seam="device")
 
@@ -1221,9 +1261,15 @@ class CompiledPipeline:
         the classifier) propagate immediately — the ladder only absorbs
         transient device faults.  The circuit breaker counts batches that
         fell to the host rung; once tripped, the run stays on the host
-        backend (no more device dispatches to time out on).
+        backend (no more device dispatches to time out on) until the
+        half-open cooldown grants a probe.
         """
-        if self._breaker.tripped:
+        if inflight is _BREAKER_OPEN or (
+            inflight is None and not self._breaker.allow_request()
+        ):
+            # The breaker refused the dispatch (window sentinel) or refuses
+            # the re-dispatch now: host rung, with no breaker recording —
+            # the device was never asked.
             return self._host_rerun(batch.docs), []
         try:
             stats = self._device_fetch(batch, phase, inflight)
@@ -1335,13 +1381,107 @@ class CompiledPipeline:
         assert len(self.phases) == 1
         return self.assemble_batch(batch, self.dispatch_batch(batch))
 
+    def _timed_pack(
+        self, docs: List[TextDocument], batch_size: int, max_len: int
+    ) -> PackedBatch:
+        """``pack_documents`` with the pack-stage wall clock attached."""
+        import time
+
+        t0 = time.perf_counter()
+        try:
+            return pack_documents(docs, batch_size=batch_size, max_len=max_len)
+        finally:
+            METRICS.inc("stage_pack_seconds", time.perf_counter() - t0)
+
+    def _pack_pool(self):
+        if self._pack_pool_obj is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pack_pool_obj = ThreadPoolExecutor(
+                max_workers=max(1, self._overlap.pack_workers),
+                thread_name_prefix="textblast-pack",
+            )
+        return self._pack_pool_obj
+
+    def _packed_source(self, docs_iter, host_tail_max, route_fn, overlapped):
+        """The packer stage for one phase.
+
+        Serial: the grouping generator inline, packing on the caller's
+        thread.  Overlapped: the generator runs ahead on a prefetch thread
+        and each ``pack`` is a thread-pool future (the encode/scatter work
+        releases the GIL), so grouping+packing of batch i+1.. overlap the
+        caller's dispatch/assembly of batch i.  Either way items arrive in
+        the generator's order — the overlap changes timing, never sequence.
+
+        Returns ``(iterable of (batch_or_future, fallback_docs), close_fn)``.
+        """
+        kwargs = dict(
+            batch_size=self.batch_size,
+            buckets=self.buckets,
+            host_tail_max=host_tail_max,
+            route_fn=route_fn,
+        )
+        if not overlapped:
+            gen = iter_packed_batches(docs_iter, pack_fn=self._timed_pack, **kwargs)
+            return gen, lambda: None
+        pool = self._pack_pool()
+
+        def submit(docs, batch_size, max_len):
+            return pool.submit(
+                self._timed_pack, docs, batch_size=batch_size, max_len=max_len
+            )
+
+        gen = iter_packed_batches(docs_iter, pack_fn=submit, **kwargs)
+        pf = prefetch_iter(
+            gen, depth=max(2, self._overlap.pack_workers + 1), block=1
+        )
+        return pf, pf.close
+
+    def _dispatch_window(self, batch: PackedBatch, phase: int, no_overlap: bool):
+        """Breaker-gated async dispatch for the in-flight window.
+
+        Returns the in-flight stats tree, ``None`` on a retryable launch
+        failure (the drain's ladder re-dispatches from scratch), or the
+        ``_BREAKER_OPEN`` sentinel when the breaker refused the request.
+        Deterministic errors propagate — the ladder only absorbs transient
+        device faults.
+        """
+        if not self._breaker.allow_request():
+            return _BREAKER_OPEN
+        try:
+            stats = self.dispatch_batch(batch, phase)
+            if no_overlap:
+                jax.block_until_ready(stats)
+            return stats
+        except Exception as e:  # noqa: BLE001
+            if self._retry.classify(e) != "retryable":
+                raise
+            # Failed launch: hand the batch to the ladder with nothing in
+            # flight (its first retry attempt re-dispatches).
+            logger.warning("Device dispatch failed (phase %d): %s", phase, e)
+            return None
+
     def process_chunk(self, docs: List[TextDocument]) -> Iterator[ProcessingOutcome]:
         """Run one chunk of documents through every phase, repacking the
-        survivors between phases (device-side short-circuit)."""
+        survivors between phases (device-side short-circuit).
+
+        Batches ride a FIFO in-flight window ``pipeline_depth`` deep: batch
+        i's host assembly/post-passes run while batches i+1..i+K compute on
+        the device.  Outcomes are emitted in the strict FIFO order of the
+        packer's output items at EVERY depth — the window moves the waits,
+        never the sequence — so serial (depth 1, or --no-overlap) and
+        overlapped runs produce byte-identical outcome streams by
+        construction.
+        """
         import os
         import time
 
         debug = os.environ.get("TEXTBLAST_PHASE_DEBUG") == "1"
+        no_overlap = os.environ.get("TEXTBLAST_NO_OVERLAP") == "1"
+        overlapped = (
+            self._overlap.enabled and not no_overlap and self.mesh is None
+        )
+        depth = max(1, self._overlap.pipeline_depth) if overlapped else 1
         current: List[TextDocument] = docs
         if self._route_dict_scripts or self.wire_u16:
             from ..utils.cjk import has_astral, has_dict_script
@@ -1358,10 +1498,14 @@ class CompiledPipeline:
             _host_routed = None
         for phase in range(len(self.phases)):
             t0 = time.perf_counter()
-            t_dispatch = t_assemble = 0.0
+            timing = {"dispatch": 0.0, "drain": 0.0}
             n_in, n_batches = len(current), 0
             survivors: List[TextDocument] = []
-            pending = None  # one batch in flight per phase
+            # FIFO window entries: ("batch", (batch, stats)) dispatched and
+            # awaiting assembly, or ("host", docs) fallback groups awaiting
+            # their host-oracle pass.  ``inflight`` counts batch entries only.
+            window: deque = deque()
+            inflight = 0
             # Host-oracle threshold for leftover groups: the first phase's
             # program is cheap (it exists to kill docs early), so the device
             # wins even for small groups; later phases carry the expensive
@@ -1376,70 +1520,89 @@ class CompiledPipeline:
             else:
                 host_tail_max = 0
             over_length = self.buckets[-1] - PACK_MARGIN
-            for batch, fallback in iter_packed_batches(
-                iter(current),
-                batch_size=self.batch_size,
-                buckets=self.buckets,
-                host_tail_max=host_tail_max,
-                # Phase 0 only: later phases' survivors already passed it.
-                route_fn=_host_routed if phase == 0 else None,
-            ):
-                if batch is not None:
-                    n_batches += 1
-                    td = time.perf_counter()
-                    if self._breaker.tripped:
-                        stats = None  # no device dispatch; ladder goes host
-                    else:
-                        try:
-                            stats = self.dispatch_batch(batch, phase)
-                            if os.environ.get("TEXTBLAST_NO_OVERLAP") == "1":
-                                jax.block_until_ready(stats)
-                        except Exception as e:  # noqa: BLE001
-                            if self._retry.classify(e) != "retryable":
-                                raise
-                            # Failed launch: hand the batch to the ladder
-                            # with nothing in flight (its first retry
-                            # attempt re-dispatches).
-                            logger.warning(
-                                "Device dispatch failed (phase %d): %s",
-                                phase, e,
-                            )
-                            stats = None
-                    t_dispatch += time.perf_counter() - td
-                    if pending is not None:
-                        ta = time.perf_counter()
-                        outcomes, alive = self._execute_packed(*pending)
-                        t_assemble += time.perf_counter() - ta
-                        survivors.extend(alive)
-                        yield from outcomes
-                    pending = (batch, phase, stats)
-                for doc in fallback:
+            # Phase 0 only: later phases' survivors already passed it.
+            route = _host_routed if phase == 0 else None
+
+            def _process_fallback(fallback_docs):
+                outs = []
+                for doc in fallback_docs:
                     # Over-length and routed (dict-script/astral) docs are
                     # genuine fallbacks; leftover tail groups are deliberate
                     # routing — count them apart so the bench's honesty
                     # metric stays meaningful.
                     if len(doc.content) > over_length or (
-                        _host_routed is not None
-                        and phase == 0
-                        and _host_routed(doc)
+                        route is not None and route(doc)
                     ):
                         METRICS.inc("worker_host_fallback_total")
                     else:
                         METRICS.inc("worker_host_tail_total")
                     outcome = execute_processing_pipeline(self.host_executor, doc)
                     if outcome is not None:
-                        yield outcome
-            if pending is not None:
+                        outs.append(outcome)
+                return outs
+
+            def _drain_front():
+                nonlocal inflight
+                kind, payload = window.popleft()
                 ta = time.perf_counter()
-                outcomes, alive = self._execute_packed(*pending)
-                t_assemble += time.perf_counter() - ta
-                survivors.extend(alive)
-                yield from outcomes
+                if kind == "batch":
+                    inflight -= 1
+                    METRICS.set("inflight_batches", inflight)
+                    b, stats = payload
+                    outcomes, alive = self._execute_packed(b, phase, stats)
+                    survivors.extend(alive)
+                else:
+                    outcomes = _process_fallback(payload)
+                dt = time.perf_counter() - ta
+                timing["drain"] += dt
+                METRICS.inc("stage_post_seconds", dt)
+                return outcomes
+
+            src, src_close = self._packed_source(
+                iter(current),
+                host_tail_max=host_tail_max,
+                route_fn=route,
+                overlapped=overlapped,
+            )
+            try:
+                for item, fallback in src:
+                    if item is not None:
+                        # Overlapped items are pack futures; resolving here
+                        # keeps FIFO order (futures complete out of order,
+                        # but we only ever wait on the oldest).
+                        batch = item.result() if hasattr(item, "result") else item
+                        if overlapped:
+                            METRICS.set("queue_depth_pack", src.qsize())
+                        n_batches += 1
+                        td = time.perf_counter()
+                        stats = self._dispatch_window(batch, phase, no_overlap)
+                        dt = time.perf_counter() - td
+                        timing["dispatch"] += dt
+                        METRICS.inc("stage_dispatch_seconds", dt)
+                        window.append(("batch", (batch, stats)))
+                        inflight += 1
+                        METRICS.set("inflight_batches", inflight)
+                    if fallback:
+                        window.append(("host", fallback))
+                    # Host groups at the front never block on the device —
+                    # draining them early IS the read/post overlap; batch
+                    # entries drain once more than ``depth`` are in flight.
+                    while window and (
+                        window[0][0] == "host" or inflight > depth
+                    ):
+                        yield from _drain_front()
+                while window:
+                    yield from _drain_front()
+            finally:
+                src_close()
+                METRICS.set("inflight_batches", 0)
             if debug:
                 print(
                     f"[phase {phase}] docs={n_in} batches={n_batches} "
-                    f"survivors={len(survivors)} {time.perf_counter()-t0:.2f}s "
-                    f"(dispatch {t_dispatch:.2f}s assemble {t_assemble:.2f}s)",
+                    f"survivors={len(survivors)} depth={depth} "
+                    f"{time.perf_counter()-t0:.2f}s "
+                    f"(dispatch {timing['dispatch']:.2f}s "
+                    f"drain {timing['drain']:.2f}s)",
                     flush=True,
                 )
             current = survivors
@@ -1513,12 +1676,13 @@ def process_documents_device(
     runs the compiled pipeline, assembles outcomes in input order per batch.
 
     Outcome **ordering** is deterministic but not input order: documents are
-    grouped by length bucket, one batch is kept in flight (assembly of batch
-    k overlaps device compute of batch k+1), and host-fallback outliers are
-    yielded when encountered, so outcomes interleave across batches.  Output
-    row order is NOT contractual — the reference has none either (its results
-    queue returns worker-completion order, producer_logic.rs:141-176); tests
-    compare outputs as id-keyed sets.
+    grouped by length bucket and emitted in the packer's strict FIFO item
+    order, with up to ``overlap.pipeline_depth`` batches in flight (assembly
+    of batch k overlaps device compute of batches k+1..k+K).  The order is
+    identical at every depth — serial and overlapped runs produce the same
+    outcome stream.  Output row order is NOT contractual — the reference has
+    none either (its results queue returns worker-completion order,
+    producer_logic.rs:141-176); tests compare outputs as id-keyed sets.
 
     Pass a prebuilt ``pipeline`` to reuse its compiled programs across
     multiple streams (the checkpointed runner processes one chunk per call)."""

@@ -136,6 +136,7 @@ def aggregate_results_from_stream(
     published_count: Optional[int] = None,
     progress: Optional[Callable[[AggregationResult], None]] = None,
     deadletter=None,
+    write_queue: int = 0,
 ) -> AggregationResult:
     """Route outcomes to the kept/excluded Parquet pair
     (producer_logic.rs:109-196).  Broker-independent: accepts any iterable of
@@ -146,6 +147,12 @@ def aggregate_results_from_stream(
     additionally receives every Error outcome; the kept/excluded pair still
     gets neither-file behavior for them, so the default artifacts are
     byte-identical with or without the sink.
+
+    ``write_queue`` > 0 moves the actual Parquet writes onto a writer
+    thread behind a bounded FIFO queue that deep (the overlapped pipeline's
+    writer stage).  Batch boundaries and order are unchanged, so the files
+    are byte-identical either way; a write error surfaces at the next
+    ``write_batch`` or at close instead of at the failing call.
     """
     import os
 
@@ -156,6 +163,11 @@ def aggregate_results_from_stream(
 
     out_writer = ParquetWriter(output_file)
     excl_writer = ParquetWriter(excluded_file)
+    if write_queue > 0:
+        from .utils.overlap import ThreadedWriter
+
+        out_writer = ThreadedWriter(out_writer, max_queue=write_queue)
+        excl_writer = ThreadedWriter(excl_writer, max_queue=write_queue)
 
     result = AggregationResult()
     out_batch: list[TextDocument] = []

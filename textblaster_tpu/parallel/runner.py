@@ -19,7 +19,9 @@ Two backends share the orchestration:
 from __future__ import annotations
 
 import logging
+import os
 import sys
+import time
 from typing import Optional
 
 from ..config.pipeline import PipelineConfig
@@ -43,21 +45,26 @@ class _Progress:
     """Single-line progress display (the reference's indicatif bars,
     bin/producer.rs:31-46)."""
 
-    def __init__(self, enabled: bool) -> None:
+    def __init__(self, enabled: bool, min_interval_s: float = 0.1) -> None:
         self.enabled = enabled and sys.stderr.isatty()
-        self._last = 0
+        self.min_interval_s = min_interval_s
+        self._last_t = 0.0
 
     def update(self, result: AggregationResult) -> None:
         if not self.enabled:
             return
-        if result.received - self._last >= 100 or result.received < 100:
-            print(
-                f"\rprocessed={result.received} kept={result.success} "
-                f"excluded={result.filtered} errors={result.errors}",
-                end="",
-                file=sys.stderr,
-            )
-            self._last = result.received
+        # Throttled by TIME, not document count: at high docs/s an
+        # every-N-docs refresh puts terminal IO in the hot loop.
+        now = time.monotonic()
+        if now - self._last_t < self.min_interval_s:
+            return
+        self._last_t = now
+        print(
+            f"\rprocessed={result.received} kept={result.success} "
+            f"excluded={result.filtered} errors={result.errors}",
+            end="",
+            file=sys.stderr,
+        )
 
     def finish(self) -> None:
         if self.enabled:
@@ -101,6 +108,24 @@ def run_pipeline(
         retry_policy=retry_policy,
     )
 
+    # Overlapped host pipeline (device backend only): the reader runs ahead
+    # on its own thread and the kept/excluded writers drain on a writer
+    # thread, so Parquet IO overlaps device compute.  Both are strict FIFO —
+    # outputs are byte-identical to the serial path.
+    oc = getattr(config, "overlap", None)
+    overlapped = (
+        backend == "tpu"
+        and oc is not None
+        and oc.enabled
+        and os.environ.get("TEXTBLAST_NO_OVERLAP") != "1"
+    )
+    if overlapped:
+        from ..utils.overlap import prefetch_iter
+
+        docs = prefetch_iter(
+            docs, depth=oc.read_ahead, block=max(64, read_batch_size // 4)
+        )
+
     try:
         if backend == "tpu":
             import jax
@@ -130,10 +155,13 @@ def run_pipeline(
             excluded_file=excluded_file,
             progress=progress.update,
             deadletter=deadletter,
+            write_queue=oc.write_queue if overlapped else 0,
         )
     finally:
         if deadletter is not None:
             deadletter.close()
+        if overlapped:
+            docs.close()  # stop the read-ahead thread even on error paths
     progress.finish()
     result.read_errors = read_errors[0]
     return result

@@ -17,7 +17,15 @@ from typing import Dict, List, Optional, Tuple
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["Metrics", "METRICS", "setup_prometheus_metrics"]
+__all__ = [
+    "Metrics",
+    "METRICS",
+    "setup_prometheus_metrics",
+    "STAGE_COUNTERS",
+    "stage_snapshot",
+    "stage_breakdown",
+    "format_stage_summary",
+]
 
 # Histogram buckets mirroring the reference's defaults (prometheus crate).
 _DEFAULT_BUCKETS = (
@@ -121,11 +129,136 @@ _SPECS: Dict[str, Tuple[str, str]] = {
         "counter",
         "Input rows quarantined because their row group could not be read",
     ),
+    "resilience_breaker_probe_total": (
+        "counter",
+        "Half-open probes granted by the device circuit breaker after a "
+        "cooldown",
+    ),
+    "resilience_breaker_recoveries_total": (
+        "counter",
+        "Circuit-breaker closures via a successful half-open probe "
+        "(device dispatch resumed)",
+    ),
     "deadletter_rows_total": (
         "counter",
         "Rows routed to the opt-in dead-letter (--errors-file) sink",
     ),
+    # Overlapped-pipeline stage accounting (no reference equivalent).  The
+    # counters are wall seconds spent *inside* each stage, summed across
+    # worker threads; with overlap on, stages run concurrently, so the sum
+    # can exceed end-to-end wall time — compare stages to each other, not
+    # to the clock.
+    "stage_read_seconds": (
+        "counter",
+        "Wall seconds decoding Parquet row-groups into documents",
+    ),
+    "stage_pack_seconds": (
+        "counter",
+        "Wall seconds packing documents into device batches",
+    ),
+    "stage_dispatch_seconds": (
+        "counter",
+        "Wall seconds enqueueing device programs (host-side dispatch cost)",
+    ),
+    "stage_device_wait_seconds": (
+        "counter",
+        "Wall seconds blocked on device results (device compute not hidden "
+        "by host work)",
+    ),
+    "stage_post_seconds": (
+        "counter",
+        "Wall seconds in host post-passes (assembly, TokenCounter, "
+        "C4BadWords re-decides, host-oracle reruns)",
+    ),
+    "stage_write_seconds": (
+        "counter",
+        "Wall seconds writing outcome batches to Parquet",
+    ),
+    "queue_depth_pack": (
+        "gauge",
+        "Packed batches waiting in the pack-stage queue",
+    ),
+    "queue_depth_write": (
+        "gauge",
+        "Outcome batches waiting in the writer-thread queue",
+    ),
+    "inflight_batches": (
+        "gauge",
+        "Device batches currently in flight (dispatched, not yet fetched)",
+    ),
 }
+
+#: The per-stage wall-time counters, in pipeline order.
+STAGE_COUNTERS = (
+    "stage_read_seconds",
+    "stage_pack_seconds",
+    "stage_dispatch_seconds",
+    "stage_device_wait_seconds",
+    "stage_post_seconds",
+    "stage_write_seconds",
+)
+
+
+def stage_snapshot() -> Dict[str, float]:
+    """Current values of the stage wall-time counters."""
+    return {name: METRICS.get(name) for name in STAGE_COUNTERS}
+
+
+def stage_breakdown(
+    baseline: Optional[Dict[str, float]] = None,
+) -> Dict[str, object]:
+    """Per-stage seconds (optionally relative to a snapshot) plus a
+    host-bound vs device-bound verdict.
+
+    Host seconds are read+pack+dispatch+write plus the post-pass time not
+    already accounted as device wait (the serial path blocks inside the
+    post/assembly phase, so ``post`` includes ``device_wait``; clamp at 0).
+    Device seconds are the explicit device-wait counter.  ``verdict`` is
+    "host-bound" when host work dominates, "device-bound" when the device
+    wait does, "balanced" within 20%.
+    """
+    base = baseline or {}
+    stages = {
+        name: max(0.0, METRICS.get(name) - base.get(name, 0.0))
+        for name in STAGE_COUNTERS
+    }
+    device_s = stages["stage_device_wait_seconds"]
+    post_host = max(0.0, stages["stage_post_seconds"] - device_s)
+    host_s = (
+        stages["stage_read_seconds"]
+        + stages["stage_pack_seconds"]
+        + stages["stage_dispatch_seconds"]
+        + post_host
+        + stages["stage_write_seconds"]
+    )
+    if host_s > device_s * 1.2:
+        verdict = "host-bound"
+    elif device_s > host_s * 1.2:
+        verdict = "device-bound"
+    else:
+        verdict = "balanced"
+    return {
+        "stages_s": {k: round(v, 3) for k, v in stages.items()},
+        "host_s": round(host_s, 3),
+        "device_s": round(device_s, 3),
+        "verdict": verdict,
+    }
+
+
+def format_stage_summary(
+    baseline: Optional[Dict[str, float]] = None,
+) -> str:
+    """End-of-run, human-readable stage summary (one line per stage)."""
+    b = stage_breakdown(baseline)
+    lines = ["Stage breakdown (wall seconds inside each stage):"]
+    for name in STAGE_COUNTERS:
+        label = name[len("stage_"):-len("_seconds")]
+        lines.append(f"  {label:<12} {b['stages_s'][name]:>9.3f}s")
+    lines.append(
+        f"  host {b['host_s']:.3f}s vs device-wait {b['device_s']:.3f}s "
+        f"-> {b['verdict']}"
+    )
+    return "\n".join(lines)
 
 
 class Metrics:
