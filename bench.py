@@ -56,6 +56,14 @@ LONGDOC_N_DOCS = 512
 # the oracle (partial batches cost little at 8-row batches).
 LONGDOC_BUCKETS = (4096, 8192, 12288, 16384, 24576, 32768)
 
+# Short-doc config: the skew the occupancy work targets.  Most web-crawl
+# shards are dominated by sub-500-char documents; under the default ladder
+# they all land in the 512 bucket but ride device batches sized for the
+# ladder's widest program, so most padded codepoint lanes are waste.
+# BENCH_AUTO_GEOMETRY=1 runs the same corpus through a calibrated geometry
+# (ops/geometry.py) for the A/B.
+SHORTDOC_N_DOCS = 8192
+
 # Device batch rows.  BENCH_BATCH overrides; otherwise the platform-aware
 # default from ops.pipeline.default_batch_size applies (TPU: large batches
 # amortize the tunnel's ~66ms round trip; XLA:CPU: small batches keep the
@@ -106,6 +114,9 @@ def buckets_for_platform(platform: str, bench_name: str = "full"):
         return _buckets()
     if bench_name == "longdoc":
         return LONGDOC_BUCKETS
+    # "shortdoc" deliberately keeps the default ladder: the config exists to
+    # measure what corpus-blind geometry costs on a short-skewed corpus (and
+    # what BENCH_AUTO_GEOMETRY=1 recovers).
     return _DEFAULT_BUCKETS if platform == "cpu" else _TPU_BUCKETS
 
 
@@ -233,6 +244,34 @@ def _make_longdocs(rng: np.random.Generator):
     return docs
 
 
+def _make_shortdocs(rng: np.random.Generator):
+    """Short-doc-skewed corpus (~85% under 500 chars, thin long tail): the
+    length distribution where corpus-blind geometry wastes the most padded
+    lanes."""
+    from textblaster_tpu.data_model import TextDocument
+
+    docs = []
+    for i in range(SHORTDOC_N_DOCS):
+        kind = rng.random()
+        words = _DANISH_WORDS if kind < 0.7 else _ENGLISH_WORDS
+        # 85% of docs: 1-4 sentences (~60-450 chars); 15%: the usual 3-28
+        # sentence spread up to ~1900 chars.
+        n_sentences = int(
+            rng.integers(1, 5) if rng.random() < 0.85 else rng.integers(3, 28)
+        )
+        lines = []
+        for _ in range(n_sentences):
+            n_w = int(rng.integers(4, 18))
+            ws = [words[int(rng.integers(0, len(words)))] for _ in range(n_w)]
+            lines.append(" ".join(ws).capitalize() + ".")
+        docs.append(
+            TextDocument(
+                id=f"sdoc-{i}", source="bench", content="\n".join(lines)
+            )
+        )
+    return docs
+
+
 def _make_docs(rng: np.random.Generator):
     from textblaster_tpu.data_model import TextDocument
 
@@ -356,13 +395,22 @@ def _load_config(name: str):
         return config
     if name in _BENCH_CONFIGS:
         return parse_pipeline_config(_BENCH_CONFIGS[name])
-    # "full" / "longdoc": the shipped Danish pipeline minus TokenCounter
+    # "full" / "longdoc" / "shortdoc": the shipped Danish pipeline minus
+    # TokenCounter
     # (host-side BPE step; the bench measures the device-covered filter
     # pipeline).
     with open("configs/pipeline_config.yaml", encoding="utf-8") as f:
         raw = _yaml.safe_load(f)
     raw["pipeline"] = [s for s in raw["pipeline"] if s["type"] != "TokenCounter"]
     return parse_pipeline_config(_yaml.safe_dump(raw))
+
+
+def _bench_docs(name: str, rng: np.random.Generator):
+    if name == "longdoc":
+        return _make_longdocs(rng)
+    if name == "shortdoc":
+        return _make_shortdocs(rng)
+    return _make_docs(rng)
 
 
 def _fleet_child(name: str, k: int, n: int) -> None:
@@ -382,7 +430,7 @@ def _fleet_child(name: str, k: int, n: int) -> None:
     config = _load_config(name)
     executor = build_pipeline_from_config(config)
     rng = np.random.default_rng(SEED)
-    docs = (_make_longdocs(rng) if name == "longdoc" else _make_docs(rng))[k::n]
+    docs = _bench_docs(name, rng)[k::n]
     print("READY", flush=True)
     sys.stdin.readline()
     t0 = time.perf_counter()
@@ -502,7 +550,7 @@ def main() -> int:
     config = _load_config(bench_name)
 
     rng = np.random.default_rng(SEED)
-    docs = _make_longdocs(rng) if bench_name == "longdoc" else _make_docs(rng)
+    docs = _bench_docs(bench_name, rng)
     if bench_name == "badwords":
         _, _bw_words = _badwords_cache_dir()
         # ~5% of docs get a real (boundary-separated) list hit; ~0.5% get a
@@ -588,10 +636,23 @@ def main() -> int:
     _log(f"device backend: {jax.default_backend()}")
     bench_buckets = buckets_for_platform(platform, bench_name)
     device_batch = _device_batch()
+    # BENCH_AUTO_GEOMETRY=1: calibrate the device geometry from the corpus
+    # (what `textblast run --auto-geometry` does from the stream head) and
+    # run the same measurement through it — the occupancy A/B against the
+    # default ladder above.
+    geometry = None
+    if os.environ.get("BENCH_AUTO_GEOMETRY") == "1":
+        from textblaster_tpu.ops.geometry import calibrate_geometry
+
+        geometry = calibrate_geometry(
+            [len(d.content) for d in docs], backend=jax.default_backend()
+        )
+        _log(f"auto geometry: {geometry.describe()}")
     pipeline = CompiledPipeline(
         config,
         buckets=bench_buckets,
         batch_size=device_batch,
+        geometry=geometry,
     )
     # Concurrent AOT compile of every (bucket, phase) program, then a
     # full-corpus warm pass (a small warm slice would leave some shapes cold
@@ -606,9 +667,16 @@ def main() -> int:
     warmup_s = time.perf_counter() - t0
     _log(f"device warmup (compile+first pass) done in {warmup_s:.1f}s")
 
-    from textblaster_tpu.utils.metrics import METRICS, stage_breakdown, stage_snapshot
+    from textblaster_tpu.utils.metrics import (
+        METRICS,
+        occupancy_report,
+        occupancy_snapshot,
+        stage_breakdown,
+        stage_snapshot,
+    )
 
     stage_before = stage_snapshot()
+    occupancy_before = occupancy_snapshot()
     fallbacks_before = METRICS.get("worker_host_fallback_total")
     tails_before = METRICS.get("worker_host_tail_total")
     hazards_before = METRICS.get("worker_fold_hazard_rows_total")
@@ -631,6 +699,9 @@ def main() -> int:
     # to a stage (read/pack/dispatch/device-wait/post/write) and says whether
     # the run was host- or device-bound.
     stage_report = stage_breakdown(stage_before)
+    # Occupancy over exactly the 3 timed passes: how much of the padded
+    # codepoint volume the device computed was real document content.
+    occ_report = occupancy_report(occupancy_before)
     dev_elapsed = min(device_pass_s)
     dev_rate = len(run_docs) / dev_elapsed
     _log(
@@ -733,6 +804,11 @@ def main() -> int:
         "n_docs": len(run_docs),
         "device_batch": pipeline.batch_size,
         "buckets": list(pipeline.buckets),
+        # The geometry actually dispatched (buckets + per-bucket batch rows
+        # + provenance) and its occupancy over the 3 timed passes: real vs
+        # padded codepoint lanes, waste ratio, per-bucket dispatch counts.
+        "geometry": pipeline.geometry.to_dict(),
+        "occupancy": occ_report,
         "platform": jax.default_backend(),
         "warmup_s": round(warmup_s, 1),
         "warmup_compile_s": round(compile_s, 1),

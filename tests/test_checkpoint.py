@@ -177,6 +177,85 @@ def test_device_backend_checkpointed(tmp_path, config):
     )
 
 
+def test_resume_rejects_different_geometry(tmp_path, config):
+    # Chunk boundaries are batch flush barriers: resuming under a different
+    # device geometry would batch the remaining rows differently than the
+    # original run.  The cursor records the geometry; a mismatching resume
+    # must fail fast with an actionable message naming both geometries.
+    inp = str(tmp_path / "in.parquet")
+    _write_input(inp, n=40)
+    ckpt = str(tmp_path / "ckpt")
+    out = str(tmp_path / "o.parquet")
+    excl = str(tmp_path / "e.parquet")
+    with pytest.raises(CheckpointError, match="fault injection"):
+        run_checkpointed(
+            config, inp, out, excl, ckpt_dir=ckpt, chunk_size=10,
+            backend="tpu", device_batch=8, stop_after_chunks=1,
+        )
+    state = CheckpointState.load(ckpt)
+    assert state is not None and state.geometry is not None
+    # (On XLA:CPU, batch 8 with the default ladder coincides with the knee
+    # default, so the source may legitimately read "default".)
+    assert state.geometry["source"] != "auto"
+    assert all(n == 8 for n in state.geometry["batch_sizes"])
+
+    with pytest.raises(CheckpointError, match="geometry.*x16.*original"):
+        run_checkpointed(
+            config, inp, out, excl, ckpt_dir=ckpt, chunk_size=10,
+            backend="tpu", device_batch=16,
+        )
+    # A non-auto cursor also refuses --auto-geometry.
+    with pytest.raises(CheckpointError, match="WITHOUT --auto-geometry"):
+        run_checkpointed(
+            config, inp, out, excl, ckpt_dir=ckpt, chunk_size=10,
+            backend="tpu", auto_geometry=True,
+        )
+    # The original flags resume to completion.
+    result = run_checkpointed(
+        config, inp, out, excl, ckpt_dir=ckpt, chunk_size=10,
+        backend="tpu", device_batch=8,
+    )
+    assert result.received == 40
+    assert not os.path.exists(ckpt)
+
+
+def test_auto_geometry_resume_requires_flag(tmp_path, config):
+    # An --auto-geometry run records source="auto"; resuming without the
+    # flag resolves to the default geometry and must fail with the hint to
+    # pass the flag again, while resuming WITH it reuses the recorded
+    # geometry (no recalibration) and completes.
+    inp = str(tmp_path / "in.parquet")
+    _write_input(inp, n=40)
+    ckpt = str(tmp_path / "ckpt")
+    out = str(tmp_path / "out.parquet")
+    excl = str(tmp_path / "excl.parquet")
+    with pytest.raises(CheckpointError, match="fault injection"):
+        run_checkpointed(
+            config, inp, out, excl, ckpt_dir=ckpt, chunk_size=10,
+            backend="tpu", auto_geometry=True, stop_after_chunks=1,
+        )
+    state = CheckpointState.load(ckpt)
+    assert state is not None and state.geometry["source"] == "auto"
+
+    with pytest.raises(CheckpointError, match="pass --auto-geometry again"):
+        run_checkpointed(
+            config, inp, out, excl, ckpt_dir=ckpt, chunk_size=10,
+            backend="tpu",
+        )
+    result = run_checkpointed(
+        config, inp, out, excl, ckpt_dir=ckpt, chunk_size=10,
+        backend="tpu", auto_geometry=True,
+    )
+    assert result.received == 40
+    plain_out = str(tmp_path / "p_out.parquet")
+    plain_excl = str(tmp_path / "p_excl.parquet")
+    run_pipeline(config, inp, plain_out, plain_excl, backend="host", quiet=True)
+    assert (
+        pq.read_table(out).to_pydict()["id"]
+        == pq.read_table(plain_out).to_pydict()["id"]
+    )
+
+
 def test_refuses_foreign_non_empty_directory(tmp_path, config):
     # A non-empty dir without a cursor is not ours; finalization must never
     # delete unrelated user files (e.g. --checkpoint-dir .).

@@ -194,6 +194,58 @@ pipeline:
 """
 
 
+GEOMETRY_YAML = """
+pipeline:
+  - type: GopherQualityFilter
+    min_doc_words: 6
+    max_doc_words: 100000
+    min_avg_word_length: 2.0
+    max_avg_word_length: 12.0
+    max_symbol_word_ratio: 0.3
+    max_bullet_lines_ratio: 0.9
+    max_ellipsis_lines_ratio: 0.5
+    max_non_alpha_words_ratio: 0.9
+    min_stop_words: 1
+    stop_words: [ "og", "the", "er", "i" ]
+"""
+
+
+def test_fuzz_geometry_invariance():
+    """Device geometry is a scheduling choice, never a semantic one: for
+    arbitrary valid bucket ladders and per-bucket batch sizes, every
+    document's outcome must equal the host oracle's and the default
+    geometry's (same kind, reason, content, metadata)."""
+    from textblaster_tpu.config.pipeline import parse_pipeline_config
+    from textblaster_tpu.data_model import TextDocument
+    from textblaster_tpu.ops.geometry import DeviceGeometry
+    from textblaster_tpu.ops.pipeline import process_documents_device
+
+    rng = np.random.default_rng(SEED + 4)
+    texts = [_make_doc(rng)[:1000] for _ in range(110)]
+    texts += ["", "x", "og er i " * 100]
+    host_by_id, default_by_id = run_both(GEOMETRY_YAML, texts)
+    assert_outcomes_equal(host_by_id, default_by_id)
+
+    config = parse_pipeline_config(GEOMETRY_YAML)
+    geometries = [
+        DeviceGeometry(
+            buckets=(128, 512, 1024), batch_sizes=(24, 16, 8), source="explicit"
+        ),
+        DeviceGeometry(buckets=(256, 1024), batch_sizes=(8, 32), source="auto"),
+    ]
+    for geo in geometries:
+        docs = [
+            TextDocument(id=f"d{i}", source="s", content=t)
+            for i, t in enumerate(texts)
+        ]
+        dev_by_id = {
+            o.document.id: o
+            for o in process_documents_device(config, iter(docs), geometry=geo)
+        }
+        assert set(dev_by_id) == set(host_by_id), geo.describe()
+        assert_outcomes_equal(host_by_id, dev_by_id)
+
+
 def test_fuzz_c4_before_gopher_with_trailing_step():
     """ADVICE r3 item 1: a content-REWRITING step ordered before other device
     steps with a trailing step.  The pipeline must refuse to phase-split

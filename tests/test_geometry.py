@@ -1,0 +1,208 @@
+"""Device-geometry unit + guard tests (ops/geometry.py).
+
+Covers the calibration core (waste-minimizing bucket choice, work-equalized
+batch sizes), the determinism contracts multi-host lockstep depends on
+(reservoir sampling, fixed-bin histograms, merged-histogram geometry), and
+the tier-1 guard: auto-geometry is strictly opt-in — a default-constructed
+pipeline resolves to the seed's uniform geometry and the CLI flag parses to
+False.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from textblaster_tpu.ops.geometry import (
+    CALIBRATION_SAMPLE,
+    HIST_BIN_EDGES,
+    DeviceGeometry,
+    LengthReservoir,
+    calibrate_geometry,
+    choose_buckets,
+    equalized_batch_sizes,
+    geometry_from_histogram,
+    length_histogram,
+)
+from textblaster_tpu.ops.packing import DEFAULT_BUCKETS, PACK_MARGIN
+
+
+def _waste(lengths, buckets) -> int:
+    """Padded codepoints wasted by the packer's admission rule."""
+    total = 0
+    for n in lengths:
+        b = next(b for b in sorted(buckets) if n <= b - PACK_MARGIN)
+        total += b - n
+    return total
+
+
+def _skewed_lengths(seed=11, n=4000):
+    rng = np.random.default_rng(seed)
+    short = rng.integers(30, 400, size=int(n * 0.85))
+    long = rng.integers(400, 7000, size=n - short.size)
+    return np.concatenate([short, long]).tolist()
+
+
+def test_choose_buckets_covers_every_doc_and_beats_one_bucket():
+    lengths = _skewed_lengths()
+    buckets = choose_buckets(lengths, max_programs=5)
+    assert len(buckets) <= 5
+    assert list(buckets) == sorted(set(buckets))
+    # Every doc must be admitted by some bucket (largest covers the max).
+    assert max(lengths) <= buckets[-1] - PACK_MARGIN
+    # The optimized ladder wastes strictly less than the single bucket on a
+    # skewed sample (the whole point of calibration).
+    single = choose_buckets(lengths, max_programs=1)
+    assert _waste(lengths, buckets) < _waste(lengths, single)
+
+
+def test_choose_buckets_deterministic_and_order_insensitive():
+    lengths = _skewed_lengths(seed=3)
+    a = choose_buckets(lengths)
+    b = choose_buckets(list(reversed(lengths)))
+    assert a == b
+    assert choose_buckets(lengths) == a
+
+
+def test_choose_buckets_weights_equal_repetition():
+    # A weighted sample must choose the same ladder as literally repeating
+    # the lengths — the property that lets a merged histogram stand in for
+    # raw lengths in multi-host calibration.
+    lengths = [100, 500, 2000]
+    weights = [7, 2, 1]
+    repeated = [l for l, w in zip(lengths, weights) for _ in range(w)]
+    assert choose_buckets(lengths, weights=weights) == choose_buckets(repeated)
+
+
+def test_choose_buckets_small_samples():
+    assert choose_buckets([10]) == (128,)
+    with pytest.raises(ValueError):
+        choose_buckets([])
+    # Fewer distinct lengths than the program budget: no crash, full cover.
+    bs = choose_buckets([100, 100, 100], max_programs=6)
+    assert 100 <= bs[-1] - PACK_MARGIN
+
+
+def test_equalized_batch_sizes_properties():
+    buckets = (128, 512, 2048, 8192, 65536)
+    for backend in ("cpu", "tpu"):
+        sizes = equalized_batch_sizes(buckets, backend=backend)
+        assert len(sizes) == len(buckets)
+        # Multiples of 8, and wider programs never get MORE rows.
+        assert all(n % 8 == 0 for n in sizes)
+        assert all(a >= b for a, b in zip(sizes, sizes[1:]))
+    # The explicit lane budget is honored (modulo clamps/rounding).
+    sizes = equalized_batch_sizes((1024,), backend="cpu", lane_budget=64 * 1024)
+    assert sizes == (64,)
+
+
+def test_uniform_geometry_reproduces_seed_shape():
+    g = DeviceGeometry.uniform(DEFAULT_BUCKETS, 64)
+    assert g.buckets == DEFAULT_BUCKETS
+    assert g.batch_sizes == (64,) * len(DEFAULT_BUCKETS)
+    assert g.max_batch == 64
+    assert g.source == "default"
+    for n, expect in ((100, 512), (508, 512), (509, 2048), (70000, None)):
+        assert g.bucket_for(n) == expect
+
+
+def test_geometry_roundtrip_fingerprint_and_mesh_rounding():
+    g = DeviceGeometry(buckets=(128, 2048), batch_sizes=(72, 24), source="auto")
+    assert DeviceGeometry.from_dict(g.to_dict()) == g
+    # Fingerprint covers shapes only, not provenance.
+    h = DeviceGeometry(buckets=(128, 2048), batch_sizes=(72, 24), source="explicit")
+    assert g.fingerprint() == h.fingerprint()
+    assert g.fingerprint() != DeviceGeometry.uniform((128, 2048), 72).fingerprint()
+    r = g.with_batch_multiple(16)
+    assert r.batch_sizes == (80, 32)
+    assert "128x72" in g.describe() and "(auto)" in g.describe()
+
+
+def test_geometry_validation():
+    with pytest.raises(ValueError):
+        DeviceGeometry(buckets=(), batch_sizes=())
+    with pytest.raises(ValueError):
+        DeviceGeometry(buckets=(2048, 512), batch_sizes=(8, 8))
+    with pytest.raises(ValueError):
+        DeviceGeometry(buckets=(512, 512), batch_sizes=(8, 8))
+    with pytest.raises(ValueError):
+        DeviceGeometry(buckets=(512,), batch_sizes=(8, 8))
+    with pytest.raises(ValueError):
+        DeviceGeometry(buckets=(512,), batch_sizes=(0,))
+
+
+def test_reservoir_deterministic_and_exact_below_capacity():
+    r1, r2 = LengthReservoir(capacity=64), LengthReservoir(capacity=64)
+    stream = list(range(1, 501))
+    for n in stream:
+        r1.add(n)
+        r2.add(n)
+    assert r1.lengths() == r2.lengths()
+    assert r1.n_seen == 500
+    small = LengthReservoir(capacity=16)
+    for n in stream[:10]:
+        small.add(n)
+    assert small.lengths() == tuple(stream[:10])
+
+
+def test_histogram_merge_matches_global():
+    # The multi-host contract: per-shard histograms summed elementwise equal
+    # the whole-corpus histogram, and the geometry derived from the merged
+    # histogram is identical whichever process computes it.
+    lengths = _skewed_lengths(seed=9, n=3000)
+    shards = [lengths[i::3] for i in range(3)]
+    merged = sum(length_histogram(s) for s in shards)
+    np.testing.assert_array_equal(merged, length_histogram(lengths))
+    geos = [geometry_from_histogram(merged, backend="cpu") for _ in range(3)]
+    assert all(g == geos[0] for g in geos)
+    assert geos[0].source == "auto"
+    # Bin representatives are upper edges, so every sampled doc fits.
+    assert max(lengths) <= geos[0].largest - PACK_MARGIN
+
+
+def test_histogram_overflow_lands_in_last_bin():
+    h = length_histogram([10**9])
+    assert h[-1] == 1 and h.sum() == 1
+    assert len(h) == len(HIST_BIN_EDGES)
+
+
+def test_calibrate_geometry_is_auto_and_deterministic():
+    lengths = _skewed_lengths(seed=21)
+    g1 = calibrate_geometry(lengths, backend="cpu")
+    g2 = calibrate_geometry(lengths, backend="cpu")
+    assert g1 == g2
+    assert g1.source == "auto"
+    assert g1.batch_sizes == equalized_batch_sizes(g1.buckets, backend="cpu")
+    assert CALIBRATION_SAMPLE >= 1024  # sample big enough to see the skew
+
+
+# --- tier-1 guards: auto-geometry strictly opt-in --------------------------
+
+
+def test_cli_auto_geometry_off_by_default():
+    from textblaster_tpu.cli import build_parser
+
+    args = build_parser().parse_args(
+        ["run", "-i", "in.parquet", "-o", "out.parquet", "-e", "exc.parquet",
+         "-c", "cfg.yaml"]
+    )
+    assert args.auto_geometry is False
+
+
+def test_default_pipeline_resolves_to_seed_uniform_geometry():
+    from textblaster_tpu.config.pipeline import parse_pipeline_config
+    from textblaster_tpu.ops.pipeline import CompiledPipeline, default_batch_size
+
+    config = parse_pipeline_config(
+        "pipeline:\n  - type: GopherQualityFilter\n    min_doc_words: 5\n"
+    )
+    p = CompiledPipeline(config)
+    assert p.geometry.source == "default"
+    assert p.geometry.buckets == DEFAULT_BUCKETS
+    expected = default_batch_size(DEFAULT_BUCKETS)
+    assert p.geometry.batch_sizes == (expected,) * len(DEFAULT_BUCKETS)
+    assert p.batch_size == expected
+    # Operator flags resolve to "explicit", still uniform.
+    q = CompiledPipeline(config, buckets=(512, 2048), batch_size=16)
+    assert q.geometry.source == "explicit"
+    assert q.geometry.batch_sizes == (16, 16)

@@ -139,3 +139,105 @@ def test_iter_packed_batches_pack_fn_receives_all_call_sites():
 def test_default_buckets_unchanged():
     # The packer rewrite must not touch the bucket contract.
     assert DEFAULT_BUCKETS == (512, 2048, 8192, 32768, 65536)
+
+
+# --- geometry-aware batching ------------------------------------------------
+
+
+def _mixed_docs(rng, n=120, max_len=1800):
+    texts = []
+    for _ in range(n):
+        k = int(rng.integers(1, 5))
+        idx = rng.integers(0, len(_PIECES), size=k)
+        rep = int(rng.integers(1, 40))
+        texts.append(("".join(_PIECES[i] for i in idx) * rep)[:max_len])
+    return _docs(texts)
+
+
+def _drain(batches):
+    """Normalize an iter_packed_batches stream for comparison."""
+    out = []
+    for batch, host_docs in batches:
+        if batch is None:
+            out.append(("host", [d.id for d in host_docs]))
+        else:
+            out.append(
+                (
+                    "device",
+                    batch.cps.shape,
+                    batch.lengths.tolist(),
+                    [d.id for d in batch.docs],
+                    [d.id for d in host_docs],
+                )
+            )
+    return out
+
+
+def test_uniform_geometry_reduces_to_seed_batching():
+    # DeviceGeometry.uniform must reproduce the batch_size path EXACTLY —
+    # same batches, same shapes, same order, same host-tail grouping.  This
+    # is the default-stays-byte-identical guarantee at the packer seam.
+    from textblaster_tpu.ops.geometry import DeviceGeometry
+
+    rng = np.random.default_rng(515)
+    docs = _mixed_docs(rng)
+    for host_tail_max in (0, 6):
+        old = _drain(
+            iter_packed_batches(
+                iter([d.copy() for d in docs]),
+                batch_size=16,
+                buckets=(64, 512, 2048),
+                host_tail_max=host_tail_max,
+            )
+        )
+        new = _drain(
+            iter_packed_batches(
+                iter([d.copy() for d in docs]),
+                geometry=DeviceGeometry.uniform((64, 512, 2048), 16),
+                host_tail_max=host_tail_max,
+            )
+        )
+        assert old == new
+
+
+def test_per_bucket_batch_sizes_respected():
+    from textblaster_tpu.ops.geometry import DeviceGeometry
+
+    geo = DeviceGeometry(
+        buckets=(64, 512, 2048), batch_sizes=(32, 16, 8), source="explicit"
+    )
+    rng = np.random.default_rng(77)
+    docs = _mixed_docs(rng, n=200)
+    seen = {}
+    ids = []
+    for batch, host_docs in iter_packed_batches(iter(docs), geometry=geo):
+        assert not host_docs or batch is None
+        if batch is None:
+            ids.extend(d.id for d in host_docs)
+            continue
+        rows, length = batch.cps.shape
+        assert rows == geo.batch_for(length)
+        assert len(batch.docs) <= rows
+        # Every doc rides the smallest admitting bucket.
+        for d in batch.docs:
+            assert geo.bucket_for(len(d.content)) == length
+        seen.setdefault(length, 0)
+        seen[length] += len(batch.docs)
+        ids.extend(d.id for d in batch.docs)
+    # No doc lost or duplicated across the per-bucket streams.
+    assert sorted(ids) == sorted(d.id for d in docs)
+    assert seen  # at least one device batch
+
+
+def test_overflow_flush_parameter():
+    # Docs longer than every bucket flush to the host in groups capped by
+    # overflow_flush (previously a hardcoded 64).
+    docs = _docs(["x" * 100] * 7)
+    out = list(
+        iter_packed_batches(
+            iter(docs), batch_size=8, buckets=(64,), overflow_flush=3
+        )
+    )
+    host_groups = [[d.id for d in hd] for b, hd in out if b is None and hd]
+    assert [len(g) for g in host_groups] == [3, 3, 1]
+    assert [i for g in host_groups for i in g] == [d.id for d in docs]

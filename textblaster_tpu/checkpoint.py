@@ -110,6 +110,12 @@ class CheckpointState:
     # ``errors_file``); absent in pre-resilience checkpoints, so the default
     # keeps old cursors loadable.
     err_parts: List[str] = field(default_factory=list)
+    # The device geometry the run was started with (DeviceGeometry.to_dict()).
+    # Chunk boundaries are batch flush barriers, so resuming with a different
+    # geometry would silently reshuffle batches — resume verifies this field
+    # and fails fast on mismatch.  Absent in pre-geometry cursors (None), so
+    # the default keeps old cursors loadable.
+    geometry: Optional[dict] = None
     version: int = _VERSION
 
     def save(
@@ -243,6 +249,7 @@ def run_checkpointed(
     read_batch_size: int = 1024,
     device_batch: Optional[int] = None,
     buckets=None,
+    auto_geometry: bool = False,
     mesh=None,
     progress: Optional[Callable[[AggregationResult], None]] = None,
     stop_after_chunks: Optional[int] = None,
@@ -270,6 +277,7 @@ def run_checkpointed(
     retry_policy = RetryPolicy.from_config(rc) if rc is not None else RetryPolicy()
 
     state = CheckpointState.load(ckpt_dir)
+    resumed = state is not None
     if state is None and os.listdir(ckpt_dir):
         # A non-empty directory without a cursor is not ours: finalization
         # deletes the subsystem's artifacts, and starting a run inside e.g.
@@ -348,15 +356,97 @@ def run_checkpointed(
     if backend == "tpu":
         import jax
 
+        from .ops.geometry import DeviceGeometry
         from .ops.pipeline import CompiledPipeline, process_documents_device
         from .parallel.mesh import data_mesh
 
         if mesh is None and len(jax.devices()) > 1:
             mesh = data_mesh()  # same sharding as the non-checkpointed runner
         pkw = {} if buckets is None else {"buckets": buckets}
-        pipeline = CompiledPipeline(
-            config, batch_size=device_batch, mesh=mesh, **pkw
+        recorded = (
+            DeviceGeometry.from_dict(state.geometry)
+            if state.geometry is not None
+            else None
         )
+        if resumed and recorded is not None:
+            # The cursor's geometry is authoritative: chunk boundaries are
+            # batch flush barriers, and a different geometry would batch the
+            # remaining rows differently than the original run would have.
+            # Verify the flags resolve to the recorded geometry (or, for an
+            # auto run, that --auto-geometry is passed again) and fail fast
+            # otherwise.
+            if auto_geometry:
+                if recorded.source != "auto":
+                    raise CheckpointError(
+                        f"checkpoint in '{ckpt_dir}' was created WITHOUT "
+                        f"--auto-geometry (device geometry "
+                        f"{recorded.describe()}); resume without the flag, "
+                        "or remove the checkpoint directory to start over"
+                    )
+                pipeline = CompiledPipeline(config, mesh=mesh, geometry=recorded)
+            else:
+                candidate = CompiledPipeline(
+                    config, batch_size=device_batch, mesh=mesh, **pkw
+                )
+                if candidate.geometry.fingerprint() != recorded.fingerprint():
+                    hint = (
+                        "pass --auto-geometry again"
+                        if recorded.source == "auto"
+                        else "resume with the original --buckets/--device-batch"
+                    )
+                    raise CheckpointError(
+                        f"checkpoint in '{ckpt_dir}' was created with device "
+                        f"geometry {recorded.describe()}, but this invocation "
+                        f"resolves to {candidate.geometry.describe()}; {hint}, "
+                        "or remove the checkpoint directory to start over"
+                    )
+                pipeline = candidate
+        else:
+            if resumed and auto_geometry:
+                # Pre-geometry cursor: the original batching cannot be
+                # reconstructed under a freshly calibrated geometry.
+                raise CheckpointError(
+                    f"checkpoint in '{ckpt_dir}' predates geometry recording "
+                    "and cannot be resumed with --auto-geometry; resume "
+                    "without the flag, or remove the checkpoint directory "
+                    "to start over"
+                )
+            geometry = None
+            if auto_geometry:
+                # Fresh run: calibrate from the head of the stream, then
+                # replay the head ahead of the rest.  The result is recorded
+                # in the cursor so a resume dispatches identical batches.
+                from itertools import chain
+
+                from .ops.geometry import CALIBRATION_SAMPLE, calibrate_geometry
+
+                head = list(islice(raw, CALIBRATION_SAMPLE))
+                lengths = [
+                    len(d.content)
+                    for d in head
+                    if not isinstance(d, PipelineError)
+                ]
+                if lengths:
+                    geometry = calibrate_geometry(
+                        lengths, backend=jax.default_backend()
+                    )
+                    logger.info(
+                        "Auto-calibrated device geometry from %d sampled "
+                        "documents: %s",
+                        len(lengths),
+                        geometry.describe(),
+                    )
+                raw = chain(head, raw)
+            pipeline = CompiledPipeline(
+                config,
+                batch_size=device_batch,
+                mesh=mesh,
+                geometry=geometry,
+                **pkw,
+            )
+        # Recorded from the constructed pipeline (mesh rounding included) so
+        # the resume check compares like with like.
+        state.geometry = pipeline.geometry.to_dict()
 
         def process_chunk(items) -> Iterator[ProcessingOutcome]:
             return process_documents_device(

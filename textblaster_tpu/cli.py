@@ -69,6 +69,16 @@ def build_parser() -> argparse.ArgumentParser:
                           "fallback.  Default: the built-in long-doc set.")
     run.add_argument("--device-batch", type=int, default=None,
                      help="Documents per device batch (tpu backend)")
+    run.add_argument("--auto-geometry", action="store_true",
+                     help="Calibrate device geometry from the data: sample "
+                          "document lengths from the head of the stream, "
+                          "choose bucket boundaries minimizing padded-"
+                          "codepoint waste, and give each bucket a work-"
+                          "equalized batch size (B ∝ lane_budget / bucket).  "
+                          "Off by default (the built-in geometry is used); "
+                          "mutually exclusive with --buckets and "
+                          "--device-batch.  Checkpointed runs record the "
+                          "calibrated geometry and resume with it")
     run.add_argument("--pipeline-depth", type=int, default=None,
                      help="Device batches kept in flight by the overlapped "
                           "host pipeline (default: the config's "
@@ -171,6 +181,16 @@ def _cmd_run(args: argparse.Namespace) -> int:
             print(f"Invalid --buckets value: {args.buckets!r}", file=sys.stderr)
             return 1
 
+    if args.auto_geometry and (buckets or args.device_batch):
+        print("--auto-geometry chooses buckets and batch sizes itself; "
+              "it cannot be combined with --buckets or --device-batch",
+              file=sys.stderr)
+        return 1
+    if args.auto_geometry and args.backend == "host":
+        print("--auto-geometry tunes the device geometry; it has no effect "
+              "on --backend host", file=sys.stderr)
+        return 1
+
     start = time.perf_counter()
     fallbacks_before = METRICS.get("worker_host_fallback_total")
 
@@ -197,6 +217,8 @@ def _cmd_run(args: argparse.Namespace) -> int:
                 mh_kwargs["buckets"] = buckets
             if args.device_batch:
                 mh_kwargs["device_batch"] = args.device_batch
+            if args.auto_geometry:
+                mh_kwargs["auto_geometry"] = True
             result = run_multihost(
                 config,
                 args.input_file,
@@ -228,6 +250,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
                 read_batch_size=args.batch_size,
                 device_batch=args.device_batch,
                 buckets=buckets,
+                auto_geometry=args.auto_geometry,
                 progress=progress.update,
                 errors_file=args.errors_file,
             )
@@ -246,6 +269,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
                 read_batch_size=args.batch_size,
                 device_batch=args.device_batch,
                 buckets=buckets,
+                auto_geometry=args.auto_geometry,
                 quiet=args.quiet,
                 errors_file=args.errors_file,
             )
@@ -299,10 +323,16 @@ def _cmd_run(args: argparse.Namespace) -> int:
         print(f"Warning: {result.read_errors} rows could not be read.",
               file=sys.stderr)
     if not args.quiet:
-        from .utils.metrics import STAGE_COUNTERS, format_stage_summary
+        from .utils.metrics import (
+            STAGE_COUNTERS,
+            format_occupancy_summary,
+            format_stage_summary,
+        )
 
         if any(METRICS.get(name) > 0 for name in STAGE_COUNTERS):
             print(format_stage_summary(), file=sys.stderr)
+        if METRICS.get("occupancy_device_batches_total") > 0:
+            print(format_occupancy_summary(), file=sys.stderr)
     return 0
 
 

@@ -382,6 +382,7 @@ class OverlapConfig:
     pack_workers: int = 2      # threads over the GIL-releasing pack work
     read_ahead: int = 4        # Parquet read-ahead queue, in read batches
     write_queue: int = 8       # writer-thread queue, in outcome batches
+    overflow_flush: int = 64   # host-fallback docs buffered before a flush
 
     def validate(self) -> None:
         for name, val, lo in (
@@ -389,6 +390,7 @@ class OverlapConfig:
             ("pack_workers", self.pack_workers, 1),
             ("read_ahead", self.read_ahead, 1),
             ("write_queue", self.write_queue, 1),
+            ("overflow_flush", self.overflow_flush, 1),
         ):
             if val < lo:
                 raise ConfigValidationError(

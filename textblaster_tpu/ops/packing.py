@@ -141,6 +141,8 @@ def iter_packed_batches(
     host_tail_max: int = 0,
     route_fn=None,
     pack_fn=pack_documents,
+    geometry=None,
+    overflow_flush: int = 64,
 ) -> Iterator[Tuple[Optional[PackedBatch], List[TextDocument]]]:
     """Group a document stream into per-bucket batches.
 
@@ -150,18 +152,39 @@ def iter_packed_batches(
     ``route_fn(doc) -> bool`` marks additional host-oracle documents (e.g.
     dictionary-script or astral rows, ops/pipeline.py): they join the same
     interleaved fallback stream, so their host processing overlaps in-flight
-    device batches instead of serializing ahead of the first dispatch.
+    device batches instead of serializing ahead of the first dispatch; the
+    fallback list is flushed every ``overflow_flush`` documents.
+
+    ``geometry`` (an ``ops.geometry.DeviceGeometry``) supersedes
+    ``buckets``/``batch_size`` and assigns each bucket its own row count, so
+    wide buckets dispatch fewer rows and narrow buckets more — equalizing
+    padded-lane volume per dispatch.  Without it, behavior is the uniform
+    seed geometry: one ``batch_size`` for every bucket.
 
     End-of-stream handling: a device program computes every padded row, so
     per-bucket partial flushes waste most of their cost.  Leftovers from all
-    buckets are merged (sorted by length), split into ``batch_size`` groups,
-    and each group is packed at the smallest bucket that fits its longest
-    document — one near-full batch instead of several near-empty ones.
-    Groups of at most ``host_tail_max`` documents are handed back as
-    fallback docs: below that size the (bit-exact) host oracle is cheaper
-    than any padded device batch.
+    buckets are merged (sorted by length) and regrouped greedily: a group
+    flushes once it reaches the batch size of the bucket its longest (most
+    recent) document needs — with a uniform geometry this degenerates to
+    exactly the historical ``batch_size``-sized slices.  Each group is
+    packed at the smallest bucket that fits its longest document — one
+    near-full batch instead of several near-empty ones.  Groups of at most
+    ``host_tail_max`` documents are handed back as fallback docs: below
+    that size the (bit-exact) host oracle is cheaper than any padded device
+    batch.  ``host_tail_max`` may be a per-bucket mapping — with unequal
+    row budgets the "below ~a fraction of a batch" cutoff must follow the
+    group's own bucket, not one global row count.
     """
-    buckets = tuple(sorted(buckets))
+    if geometry is not None:
+        buckets = tuple(geometry.buckets)
+        rows_for = {b: geometry.batch_for(b) for b in buckets}
+    else:
+        buckets = tuple(sorted(buckets))
+        rows_for = {b: batch_size for b in buckets}
+    if isinstance(host_tail_max, dict):
+        tail_for = {b: int(host_tail_max.get(b, 0)) for b in buckets}
+    else:
+        tail_for = {b: int(host_tail_max) for b in buckets}
     margin = PACK_MARGIN
     largest = buckets[-1] - margin
     pending: dict[int, List[TextDocument]] = {b: [] for b in buckets}
@@ -171,30 +194,51 @@ def iter_packed_batches(
         n_chars = len(doc.content)
         if n_chars > largest or (route_fn is not None and route_fn(doc)):
             overflow.append(doc)
-            if len(overflow) >= 64:
+            if len(overflow) >= overflow_flush:
                 yield None, overflow
                 overflow = []
             continue
         for b in buckets:
             if n_chars <= b - margin:
                 pending[b].append(doc)
-                if len(pending[b]) >= batch_size:
+                if len(pending[b]) >= rows_for[b]:
                     batch_docs, pending[b] = pending[b], []
                     yield pack_fn(
-                        batch_docs, batch_size=batch_size, max_len=b
+                        batch_docs, batch_size=rows_for[b], max_len=b
                     ), []
                 break
 
     leftovers = [d for b in buckets for d in pending[b]]
     leftovers.sort(key=lambda d: len(d.content))
-    for i in range(0, len(leftovers), batch_size):
-        group = leftovers[i : i + batch_size]
-        if len(group) <= host_tail_max:
+    group: List[TextDocument] = []
+    group_bucket = buckets[0]
+    for doc in leftovers:
+        need = next(b for b in buckets if len(doc.content) <= b - margin)
+        # Ascending lengths mean `need` only grows and (with equalized
+        # geometry) its row budget only shrinks; flush when the group
+        # already fills the incoming document's budget.
+        if group and len(group) >= rows_for[need]:
+            if len(group) <= tail_for[group_bucket]:
+                yield None, group
+            else:
+                yield pack_fn(
+                    group, batch_size=rows_for[group_bucket], max_len=group_bucket
+                ), []
+            group = []
+        group.append(doc)
+        group_bucket = need
+        if len(group) >= rows_for[need]:
+            if len(group) <= tail_for[need]:
+                yield None, group
+            else:
+                yield pack_fn(group, batch_size=rows_for[need], max_len=need), []
+            group = []
+    if group:
+        if len(group) <= tail_for[group_bucket]:
             yield None, group
-            continue
-        need = next(
-            b for b in buckets if len(group[-1].content) <= b - margin
-        )
-        yield pack_fn(group, batch_size=batch_size, max_len=need), []
+        else:
+            yield pack_fn(
+                group, batch_size=rows_for[group_bucket], max_len=group_bucket
+            ), []
     if overflow:
         yield None, overflow

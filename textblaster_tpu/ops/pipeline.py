@@ -23,6 +23,7 @@ the host oracle — the outlier path SURVEY.md §5 calls for.
 from __future__ import annotations
 
 import logging
+import time as _time_mod
 from collections import deque
 from functools import lru_cache, partial
 from typing import Callable, Dict, Iterable, Iterator, List, Optional, Tuple, Union
@@ -53,6 +54,7 @@ from ..utils.metrics import METRICS
 from ..utils.overlap import prefetch_iter
 from .badwords import badwords_matches_multi
 from .langid_tpu import langid_scores
+from .geometry import DeviceGeometry
 from .packing import (
     DEFAULT_BUCKETS,
     PACK_MARGIN,
@@ -201,6 +203,7 @@ class _StepEval:
         "overflow",
         "decide",
         "pass_stamps",
+        "pass_stamp_fn",
         "c4_line_keep",
         "c4_n_lines",
         "c4_rewrite_identity",
@@ -214,8 +217,11 @@ class _StepEval:
         self.overflow = overflow
         self.decide = decide
         # Constant stamps for passing rows; None means even passing rows need
-        # decide() (per-row stamp values or host-side work).
+        # decide() (per-row stamp values or host-side work) — unless
+        # pass_stamp_fn supplies the per-row stamps from batch-precomputed
+        # arrays (the assemble_phase fast path).
         self.pass_stamps = pass_stamps
+        self.pass_stamp_fn = None
         self.c4_line_keep = None
         self.c4_n_lines = None
         self.c4_rewrite_identity = None
@@ -240,6 +246,19 @@ def default_batch_size(buckets=DEFAULT_BUCKETS) -> int:
     if jax.default_backend() == "cpu":
         return max(8, min(256, (64 * 2048) // max_bucket))
     return max(64, min(1024, (1024 * 2048) // max_bucket))
+
+
+def record_occupancy(batch: PackedBatch) -> None:
+    """Occupancy telemetry for one device dispatch (see utils/metrics.py):
+    real codepoints vs padded lanes actually computed, plus a per-bucket
+    dispatch counter.  Called at every dispatch seam (single-host
+    ``dispatch_batch``, the multi-host lockstep loop) so the waste ratio in
+    the CLI/bench reports reflects what the device really executed."""
+    rows, length = batch.cps.shape
+    METRICS.inc("occupancy_device_batches_total")
+    METRICS.inc("occupancy_padded_lanes_total", float(rows) * float(length))
+    METRICS.inc("occupancy_real_codepoints_total", float(int(batch.lengths.sum())))
+    METRICS.inc(f"occupancy_dispatches_bucket_{length}")
 
 
 # Step types that cheaply kill many documents: a phase boundary after them
@@ -300,16 +319,30 @@ class CompiledPipeline:
         batch_size: Optional[int] = None,
         mesh=None,
         phase_split: bool = True,
+        geometry: Optional[DeviceGeometry] = None,
     ) -> None:
         self.config = config
-        self.buckets = tuple(sorted(buckets))
         self.mesh = mesh
-        if not batch_size:  # None or 0 — the CLI passes ints through unguarded
-            batch_size = default_batch_size(self.buckets)
-        if mesh is not None:
-            n_dev = mesh.devices.size
-            batch_size = max(n_dev, (batch_size // n_dev) * n_dev)
-        self.batch_size = batch_size
+        if geometry is not None:
+            # Calibrated (or checkpoint-recorded) geometry supersedes the
+            # buckets/batch_size knobs; mesh runs need every per-bucket batch
+            # divisible by the device count.
+            if mesh is not None:
+                geometry = geometry.with_batch_multiple(mesh.devices.size)
+            self.geometry = geometry
+        else:
+            bs = tuple(sorted(buckets))
+            if not batch_size:  # None or 0 — CLI passes ints through unguarded
+                batch_size = default_batch_size(bs)
+            if mesh is not None:
+                n_dev = mesh.devices.size
+                batch_size = max(n_dev, (batch_size // n_dev) * n_dev)
+            src = "default" if batch_size == default_batch_size(bs) else "explicit"
+            self.geometry = DeviceGeometry.uniform(bs, batch_size, source=src)
+        self.buckets = self.geometry.buckets
+        # The representative (largest) per-dispatch row count: chunk sizing,
+        # host-tail thresholds, and multi-host sharding key off it.
+        self.batch_size = self.geometry.max_batch
 
         steps = list(config.pipeline)
         n_device = 0
@@ -591,9 +624,9 @@ class CompiledPipeline:
     ) -> Callable:
         """Program for one (bucket length, phase) — and, for the ladder's
         split rung, a separate cache entry per non-standard row count:
-        ``warmup_parallel`` installs AOT executables fixed to
-        ``(batch_size, length)``, which a half-sized batch must never hit."""
-        if rows is not None and rows != self.batch_size:
+        ``warmup_parallel`` installs AOT executables fixed to the bucket's
+        geometry rows, which a half-sized batch must never hit."""
+        if rows is not None and rows != self.geometry.batch_for(length):
             key = (length, phase, rows)
         else:
             key = (length, phase)
@@ -642,8 +675,9 @@ class CompiledPipeline:
                     continue  # already AOT-compiled
                 fn = self._fn_for(length, phase)
                 wire = jnp.uint16 if self.wire_u16 else jnp.int32
-                cps = jax.ShapeDtypeStruct((self.batch_size, length), wire)
-                lens = jax.ShapeDtypeStruct((self.batch_size,), jnp.int32)
+                rows = self.geometry.batch_for(length)
+                cps = jax.ShapeDtypeStruct((rows, length), wire)
+                lens = jax.ShapeDtypeStruct((rows,), jnp.int32)
                 jobs.append((key, fn.lower(cps, lens)))
 
         def compile_one(item):
@@ -668,11 +702,10 @@ class CompiledPipeline:
                 raise last
             if warm_dispatch:
                 length = key[0]
+                rows = self.geometry.batch_for(length)
                 wire_np = _np.uint16 if self.wire_u16 else _np.int32
-                z = jnp.asarray(
-                    _np.zeros((self.batch_size, length), dtype=wire_np)
-                )
-                zl = jnp.asarray(_np.zeros((self.batch_size,), dtype=_np.int32))
+                z = jnp.asarray(_np.zeros((rows, length), dtype=wire_np))
+                zl = jnp.asarray(_np.zeros((rows,), dtype=_np.int32))
                 jax.block_until_ready(compiled(z, zl))
             return key, compiled
 
@@ -736,8 +769,19 @@ class CompiledPipeline:
                 )
             return _Decision(True, stamps=stamps)
 
-        # Langid stamps are per-row even on pass (detected language + conf).
-        return _StepEval(passed=passed, decide=decide, pass_stamps=None)
+        # Langid stamps are per-row even on pass (detected language + conf),
+        # but their values come straight from the batch arrays: a stamp
+        # function (vectorized language-name take, same rust_float formatting
+        # as decide) lets passing rows skip decide() entirely.
+        ev = _StepEval(passed=passed, decide=decide, pass_stamps=None)
+        lang_names = np.asarray(LANGUAGES, dtype=object)[best]
+
+        def pass_stamp_fn(row: int, doc: TextDocument) -> None:
+            doc.metadata["Detected language"] = lang_names[row]
+            doc.metadata["Detected language confidence"] = rust_float(conf[row])
+
+        ev.pass_stamp_fn = pass_stamp_fn
+        return ev
 
     def _eval_gopher_rep(self, step: StepConfig, idx: int, stats) -> "_StepEval":
         p = step.params
@@ -1184,6 +1228,7 @@ class CompiledPipeline:
         previous batch's host-side assembly with this batch's device compute
         (the double-buffered feed SURVEY.md §2.5 maps prefetch/QoS onto)."""
         FAULTS.fire("device.execute")
+        record_occupancy(batch)
         fn = self._fn_for(batch.max_len, phase, rows=batch.batch_size)
         if self.mesh is not None:
             from ..parallel.mesh import shard_batch
@@ -1287,7 +1332,7 @@ class CompiledPipeline:
             # they share one traced program shape (a fresh jit entry — the
             # warmup's AOT executables are fixed to the full batch size).
             METRICS.inc("resilience_ladder_split_total")
-            sub_rows = (self.batch_size + 1) // 2
+            sub_rows = (batch.batch_size + 1) // 2
             mid = (len(batch.docs) + 1) // 2
             for part in (batch.docs[:mid], batch.docs[mid:]):
                 if not part:
@@ -1348,8 +1393,45 @@ class CompiledPipeline:
         last = phase == len(self.phases) - 1
         outcomes: List[ProcessingOutcome] = []
         survivors: List[TextDocument] = []
+        # Vectorized pass-row fast path: one batch-level AND of every step's
+        # verdict finds the rows that pass the whole phase; their only side
+        # effects are metadata pass-stamps (constant, or per-row via a
+        # batch-precomputed stamp function), so they skip the per-row
+        # decide() walk.  Rows that fail, overflow, need a non-identity C4
+        # rewrite, or hit a step without a batch verdict (badwords: the
+        # doc's language is only known per row) keep the per-row path.
+        fast_mask = None
+        if n_rows:
+            fast_mask = ~overflow_any
+            for _, ev in evals:
+                if ev.passed is None or (
+                    ev.pass_stamps is None and ev.pass_stamp_fn is None
+                ):
+                    fast_mask = None
+                    break
+                fast_mask &= ev.passed[:n_rows]
+                if ev.c4_line_keep is not None:
+                    fast_mask &= ev.c4_rewrite_identity[:n_rows]
         for row, doc in enumerate(batch.docs):
-            if overflow_any[row]:
+            if fast_mask is not None and fast_mask[row]:
+                # Passed every step: stamp in step order, exactly what
+                # _assemble_row's pass branches would have written.
+                for _, ev in evals:
+                    if ev.pass_stamps is not None:
+                        for k, v in ev.pass_stamps:
+                            doc.metadata[k] = v
+                    else:
+                        ev.pass_stamp_fn(row, doc)
+                if not last:
+                    survivors.append(doc)
+                    continue
+                if self.host_steps:
+                    outcome = execute_processing_pipeline(
+                        self.host_suffix_executor, doc
+                    )
+                else:
+                    outcome = ProcessingOutcome.success(doc)
+            elif overflow_any[row]:
                 METRICS.inc("worker_host_fallback_total")
                 outcome = execute_processing_pipeline(self.host_executor, doc)
             else:
@@ -1384,14 +1466,15 @@ class CompiledPipeline:
     def _timed_pack(
         self, docs: List[TextDocument], batch_size: int, max_len: int
     ) -> PackedBatch:
-        """``pack_documents`` with the pack-stage wall clock attached."""
-        import time
+        """``pack_documents`` with the pack-stage wall clock attached.
 
-        t0 = time.perf_counter()
+        Runs once per batch on the pack pool's hot path — the clock comes
+        from the module-scope import, not a per-call ``import time``."""
+        t0 = _time_mod.perf_counter()
         try:
             return pack_documents(docs, batch_size=batch_size, max_len=max_len)
         finally:
-            METRICS.inc("stage_pack_seconds", time.perf_counter() - t0)
+            METRICS.inc("stage_pack_seconds", _time_mod.perf_counter() - t0)
 
     def _pack_pool(self):
         if self._pack_pool_obj is None:
@@ -1416,10 +1499,10 @@ class CompiledPipeline:
         Returns ``(iterable of (batch_or_future, fallback_docs), close_fn)``.
         """
         kwargs = dict(
-            batch_size=self.batch_size,
-            buckets=self.buckets,
+            geometry=self.geometry,
             host_tail_max=host_tail_max,
             route_fn=route_fn,
+            overflow_flush=max(1, self._overlap.overflow_flush),
         )
         if not overlapped:
             gen = iter_packed_batches(docs_iter, pack_fn=self._timed_pack, **kwargs)
@@ -1514,9 +1597,13 @@ class CompiledPipeline:
             # and TEXTBLAST_HOST_TAILS=off pins tails to the device too (the
             # parity suites use it so device kernels decide every doc).
             if self.mesh is None and os.environ.get("TEXTBLAST_HOST_TAILS") != "off":
-                host_tail_max = (
-                    self.batch_size // 16 if phase == 0 else self.batch_size // 2
-                )
+                # Per-bucket: the cutoff tracks each bucket's own row budget
+                # (with a uniform geometry this is the historical scalar).
+                div = 16 if phase == 0 else 2
+                host_tail_max = {
+                    b: self.geometry.batch_for(b) // div
+                    for b in self.geometry.buckets
+                }
             else:
                 host_tail_max = 0
             over_length = self.buckets[-1] - PACK_MARGIN
@@ -1671,6 +1758,7 @@ def process_documents_device(
     buckets=DEFAULT_BUCKETS,
     mesh=None,
     pipeline: Optional[CompiledPipeline] = None,
+    geometry: Optional[DeviceGeometry] = None,
 ) -> Iterator[ProcessingOutcome]:
     """Device-backed processing loop: packs the stream into bucketed batches,
     runs the compiled pipeline, assembles outcomes in input order per batch.
@@ -1688,7 +1776,11 @@ def process_documents_device(
     multiple streams (the checkpointed runner processes one chunk per call)."""
     if pipeline is None:
         pipeline = CompiledPipeline(
-            config, buckets=buckets, batch_size=device_batch, mesh=mesh
+            config,
+            buckets=buckets,
+            batch_size=device_batch,
+            mesh=mesh,
+            geometry=geometry,
         )
         if pipeline.device_steps and not pipeline.fully_host and jax.default_backend() in (
             "tpu",

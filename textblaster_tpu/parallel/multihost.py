@@ -137,7 +137,7 @@ def run_local_shard(
     batches — the device analogue of the executor short-circuit that the
     single-controller path already had.
     """
-    from ..ops.pipeline import CompiledPipeline
+    from ..ops.pipeline import CompiledPipeline, record_occupancy
     from ..orchestration import execute_processing_pipeline
     from ..utils.metrics import METRICS
 
@@ -150,7 +150,15 @@ def run_local_shard(
     n_proc = jax.process_count()
     if pipeline is None:
         pipeline = CompiledPipeline(config, buckets=buckets, mesh=mesh)
-    local_batch = pipeline.batch_size // n_proc
+    # Per-bucket local row counts: each host feeds its 1/n_proc stripe of the
+    # bucket's global batch.  Under uniform geometry every bucket resolves to
+    # the old single ``pipeline.batch_size // n_proc``.
+    geo = pipeline.geometry
+    local_for = {
+        b: max(1, geo.batch_for(b) // n_proc) if b in geo.buckets
+        else max(1, pipeline.batch_size // n_proc)
+        for b in buckets
+    }
 
     def partition(ds: Sequence[TextDocument]):
         by_bucket: dict = {b: [] for b in buckets}
@@ -188,7 +196,7 @@ def run_local_shard(
     n_phases = len(pipeline.phases)
     for phase in range(n_phases):
         needed_local = np.array(
-            [math.ceil(len(current[b]) / local_batch) for b in buckets],
+            [math.ceil(len(current[b]) / local_for[b]) for b in buckets],
             dtype=np.int32,
         )
         schedule = _negotiate_max(needed_local)
@@ -202,9 +210,11 @@ def run_local_shard(
         pending = None  # (local_batch, device_out): one round in flight
         for b, n_rounds in zip(buckets, schedule):
             fn = pipeline._fn_for(b, phase)
+            local_batch = local_for[b]
             for r in range(int(n_rounds)):
                 chunk = current[b][r * local_batch : (r + 1) * local_batch]
                 local = pack_documents(chunk, batch_size=local_batch, max_len=b)
+                record_occupancy(local)
                 g_cps = jax.make_array_from_process_local_data(sh2, local.cps)
                 g_len = jax.make_array_from_process_local_data(sh1, local.lengths)
                 out = fn(g_cps, g_len)
@@ -251,6 +261,7 @@ def run_multihost(
     buckets: Sequence[int] = (512, 2048, 8192),
     read_batch_size: int = 1024,
     device_batch: Optional[int] = None,
+    auto_geometry: bool = False,
 ):
     """Production multi-host entry (``textblast run --coordinator ...``).
 
@@ -311,12 +322,41 @@ def run_multihost(
 
     from ..ops.pipeline import CompiledPipeline
 
+    geometry = None
+    if auto_geometry:
+        # Geometry negotiation: each host histograms ITS shard's document
+        # lengths over the fixed shape-stable bin edges, the histograms are
+        # allgathered and summed elementwise, and every host derives the
+        # geometry from the identical merged histogram — so the lockstep
+        # round schedule (which depends on buckets and batch sizes) stays in
+        # agreement without shipping raw lengths across hosts.
+        from ..ops.geometry import (
+            HIST_BIN_EDGES,
+            geometry_from_histogram,
+            length_histogram,
+        )
+
+        hist = length_histogram([len(d.content) for d in docs])
+        if num_processes > 1:
+            from jax.experimental import multihost_utils
+
+            hist = (
+                multihost_utils.process_allgather(hist.astype(np.int64))
+                .reshape(-1, len(HIST_BIN_EDGES))
+                .sum(axis=0)
+            )
+        if hist.sum() > 0:
+            geometry = geometry_from_histogram(
+                hist, backend=jax.default_backend()
+            )
+
     pipeline = CompiledPipeline(
         config, buckets=tuple(sorted(buckets)), batch_size=device_batch,
-        mesh=mesh,
+        mesh=mesh, geometry=geometry,
     )
     outcomes = run_local_shard(
-        config, docs, buckets=buckets, mesh=mesh, pipeline=pipeline
+        config, docs, buckets=pipeline.geometry.buckets, mesh=mesh,
+        pipeline=pipeline,
     )
 
     shard_out = f"{output_file}.shard{process_id}"
@@ -383,6 +423,7 @@ def _main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("-e", "--excluded-file", required=True)
     ap.add_argument("--buckets", default="512,2048,8192")
     ap.add_argument("--device-batch", type=int, default=None)
+    ap.add_argument("--auto-geometry", action="store_true")
     args = ap.parse_args(argv)
 
     config = load_pipeline_config(args.pipeline_config)
@@ -396,6 +437,7 @@ def _main(argv: Optional[Sequence[str]] = None) -> int:
         process_id=args.process_id,
         buckets=tuple(int(b) for b in args.buckets.split(",")),
         device_batch=args.device_batch,
+        auto_geometry=args.auto_geometry,
     )
     print(
         f"process {args.process_id}: {result.received} outcomes "

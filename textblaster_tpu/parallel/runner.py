@@ -82,6 +82,7 @@ def run_pipeline(
     read_batch_size: int = 1024,
     device_batch: Optional[int] = None,
     buckets=None,
+    auto_geometry: bool = False,
     quiet: bool = False,
     errors_file: Optional[str] = None,
 ) -> AggregationResult:
@@ -125,6 +126,9 @@ def run_pipeline(
         docs = prefetch_iter(
             docs, depth=oc.read_ahead, block=max(64, read_batch_size // 4)
         )
+    # The prefetch thread (if any) must be stopped on every exit path even
+    # after the calibration pass re-wraps ``docs`` in a chain below.
+    doc_source = docs
 
     try:
         if backend == "tpu":
@@ -132,6 +136,36 @@ def run_pipeline(
 
             from ..ops.pipeline import process_documents_device
             from .mesh import data_mesh
+
+            geometry = None
+            if auto_geometry:
+                # Calibration pass: buffer the head of the stream, derive
+                # waste-minimizing buckets + work-equalized batch sizes from
+                # its length distribution, then replay the head ahead of the
+                # rest — document order and content are untouched.
+                from itertools import chain, islice
+
+                from ..errors import PipelineError as _PipelineError
+                from ..ops.geometry import CALIBRATION_SAMPLE, calibrate_geometry
+
+                it = iter(docs)
+                head = list(islice(it, CALIBRATION_SAMPLE))
+                lengths = [
+                    len(d.content)
+                    for d in head
+                    if not isinstance(d, _PipelineError)
+                ]
+                if lengths:
+                    geometry = calibrate_geometry(
+                        lengths, backend=jax.default_backend()
+                    )
+                    logger.info(
+                        "Auto-calibrated device geometry from %d sampled "
+                        "documents: %s",
+                        len(lengths),
+                        geometry.describe(),
+                    )
+                docs = chain(head, it)
 
             mesh = data_mesh() if len(jax.devices()) > 1 else None
             kwargs = {} if buckets is None else {"buckets": buckets}
@@ -141,6 +175,7 @@ def run_pipeline(
                 device_batch=device_batch,
                 on_read_error=on_read_error,
                 mesh=mesh,
+                geometry=geometry,
                 **kwargs,
             )
         else:
@@ -161,7 +196,7 @@ def run_pipeline(
         if deadletter is not None:
             deadletter.close()
         if overlapped:
-            docs.close()  # stop the read-ahead thread even on error paths
+            doc_source.close()  # stop the read-ahead thread even on error paths
     progress.finish()
     result.read_errors = read_errors[0]
     return result

@@ -25,6 +25,10 @@ __all__ = [
     "stage_snapshot",
     "stage_breakdown",
     "format_stage_summary",
+    "OCCUPANCY_BUCKET_PREFIX",
+    "occupancy_snapshot",
+    "occupancy_report",
+    "format_occupancy_summary",
 ]
 
 # Histogram buckets mirroring the reference's defaults (prometheus crate).
@@ -186,7 +190,28 @@ _SPECS: Dict[str, Tuple[str, str]] = {
         "gauge",
         "Device batches currently in flight (dispatched, not yet fetched)",
     ),
+    # Device-occupancy accounting (ops/pipeline.py record_occupancy): a
+    # compiled program computes every padded lane of its fixed shape, so
+    # real/padded is the fraction of device work spent on actual text.
+    "occupancy_device_batches_total": (
+        "counter",
+        "Device batches dispatched (every backend: CPU, TPU, mesh)",
+    ),
+    "occupancy_padded_lanes_total": (
+        "counter",
+        "Codepoint lanes computed by the device across all dispatches "
+        "(rows x bucket length, padding included)",
+    ),
+    "occupancy_real_codepoints_total": (
+        "counter",
+        "Real document codepoints carried by those lanes",
+    ),
 }
+
+#: Per-bucket dispatch counters are dynamic — one counter per bucket length
+#: actually dispatched (``occupancy_dispatches_bucket_<L>``); ``render`` and
+#: the occupancy report discover them by this prefix.
+OCCUPANCY_BUCKET_PREFIX = "occupancy_dispatches_bucket_"
 
 #: The per-stage wall-time counters, in pipeline order.
 STAGE_COUNTERS = (
@@ -261,6 +286,70 @@ def format_stage_summary(
     return "\n".join(lines)
 
 
+def occupancy_snapshot() -> Dict[str, float]:
+    """Current values of every occupancy counter (per-bucket ones included)
+    — the ``baseline`` argument for a scoped ``occupancy_report``."""
+    snap = {
+        name: METRICS.get(name)
+        for name in (
+            "occupancy_device_batches_total",
+            "occupancy_padded_lanes_total",
+            "occupancy_real_codepoints_total",
+        )
+    }
+    snap.update(METRICS.prefixed(OCCUPANCY_BUCKET_PREFIX))
+    return snap
+
+
+def occupancy_report(
+    baseline: Optional[Dict[str, float]] = None,
+) -> Dict[str, object]:
+    """Device-occupancy summary, optionally relative to a snapshot.
+
+    ``waste_ratio`` is the fraction of computed codepoint lanes that carried
+    padding rather than document text — the quantity the calibrated
+    geometry minimizes."""
+    base = baseline or {}
+
+    def delta(name: str) -> float:
+        return max(0.0, METRICS.get(name) - base.get(name, 0.0))
+
+    lanes = delta("occupancy_padded_lanes_total")
+    real = delta("occupancy_real_codepoints_total")
+    per_bucket = {}
+    for name, value in sorted(
+        METRICS.prefixed(OCCUPANCY_BUCKET_PREFIX).items(),
+        key=lambda kv: int(kv[0][len(OCCUPANCY_BUCKET_PREFIX):]),
+    ):
+        d = value - base.get(name, 0.0)
+        if d > 0:
+            per_bucket[int(name[len(OCCUPANCY_BUCKET_PREFIX):])] = int(d)
+    return {
+        "device_batches": int(delta("occupancy_device_batches_total")),
+        "real_codepoints": int(real),
+        "padded_lanes": int(lanes),
+        "waste_ratio": round(1.0 - real / lanes, 4) if lanes > 0 else 0.0,
+        "per_bucket_dispatches": per_bucket,
+    }
+
+
+def format_occupancy_summary(
+    baseline: Optional[Dict[str, float]] = None,
+) -> str:
+    """One-line, human-readable occupancy report for the CLI summary."""
+    occ = occupancy_report(baseline)
+    buckets = ", ".join(
+        f"{length}x{n}" for length, n in occ["per_bucket_dispatches"].items()
+    )
+    return (
+        f"Device occupancy: {occ['real_codepoints']:,} real of "
+        f"{occ['padded_lanes']:,} computed codepoint lanes "
+        f"({occ['waste_ratio']:.1%} padding waste) across "
+        f"{occ['device_batches']} dispatches"
+        + (f" [bucket x dispatches: {buckets}]." if buckets else ".")
+    )
+
+
 class Metrics:
     """Thread-safe counter/gauge/histogram registry."""
 
@@ -286,6 +375,13 @@ class Metrics:
     def get(self, name: str) -> float:
         with self._lock:
             return self._values.get(name, 0.0)
+
+    def prefixed(self, prefix: str) -> Dict[str, float]:
+        """All dynamic counters whose name starts with ``prefix``."""
+        with self._lock:
+            return {
+                k: v for k, v in self._values.items() if k.startswith(prefix)
+            }
 
     def observe(self, name: str, value: float) -> None:
         with self._lock:
@@ -331,6 +427,19 @@ class Metrics:
                     lines.append(f'{name}_bucket{{le="+Inf"}} {cumulative}')
                     lines.append(f"{name}_sum {self._hist_sum.get(name, 0.0):g}")
                     lines.append(f"{name}_count {self._hist_total.get(name, 0)}")
+            # Dynamic per-bucket occupancy counters (one per dispatched
+            # bucket length — the set is only known at runtime).
+            dyn = sorted(
+                (k for k in self._values if k.startswith(OCCUPANCY_BUCKET_PREFIX)),
+                key=lambda k: int(k[len(OCCUPANCY_BUCKET_PREFIX):]),
+            )
+            for name in dyn:
+                lines.append(
+                    f"# HELP {name} Device dispatches at bucket length "
+                    f"{name[len(OCCUPANCY_BUCKET_PREFIX):]}"
+                )
+                lines.append(f"# TYPE {name} counter")
+                lines.append(f"{name} {self._values[name]:g}")
             return "\n".join(lines) + "\n"
 
 
