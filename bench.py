@@ -752,6 +752,66 @@ def main() -> int:
     )
     parity = agree / max(len(host_by_id), 1)
 
+    # --- Negotiated fault-guard overhead, fault-free (BENCH_RESILIENCE=0
+    # skips).  The multi-host lockstep rounds run under the negotiated guard
+    # by default (resilience/negotiated.py); its only per-round addition is
+    # one 1-int verdict allgather, so future PRs watch this A/B to see if
+    # the guard ever starts costing throughput.  Single process here, so the
+    # verdict negotiation is in-process — this bounds the protocol/Python
+    # cost, not the wire latency of a real pod.
+    resilience_report = None
+    if os.environ.get("BENCH_RESILIENCE", "1") != "0":
+        from textblaster_tpu.parallel.multihost import run_local_shard
+
+        def _shard_pass(guard_on: bool) -> float:
+            run = [d.copy() for d in docs]
+            t0 = time.perf_counter()
+            n = len(
+                run_local_shard(
+                    config, run, buckets=pipeline.geometry.buckets,
+                    pipeline=pipeline, fault_guard=guard_on,
+                )
+            )
+            return n / (time.perf_counter() - t0)
+
+        _shard_pass(False)  # untimed warm pass (mesh-path program variants)
+        neg_before = {
+            k: METRICS.get(k)
+            for k in (
+                "resilience_negotiated_rounds_total",
+                "resilience_negotiated_retries_total",
+                "resilience_negotiated_degraded_rounds_total",
+            )
+        }
+        off_rate = _shard_pass(False)
+        on_rate = _shard_pass(True)
+        resilience_report = {
+            "guard_on_docs_per_sec": round(on_rate, 2),
+            "guard_off_docs_per_sec": round(off_rate, 2),
+            "overhead_frac": round(1.0 - on_rate / off_rate, 4),
+            "negotiated_rounds": int(
+                METRICS.get("resilience_negotiated_rounds_total")
+                - neg_before["resilience_negotiated_rounds_total"]
+            ),
+            "negotiated_retries": int(
+                METRICS.get("resilience_negotiated_retries_total")
+                - neg_before["resilience_negotiated_retries_total"]
+            ),
+            "degraded_rounds": int(
+                METRICS.get("resilience_negotiated_degraded_rounds_total")
+                - neg_before["resilience_negotiated_degraded_rounds_total"]
+            ),
+            "processes": 1,
+        }
+        _log(
+            f"resilience guard: {on_rate:.1f} docs/s on vs "
+            f"{off_rate:.1f} off "
+            f"(overhead {resilience_report['overhead_frac']:+.2%}, "
+            f"{resilience_report['negotiated_rounds']} rounds, "
+            f"{resilience_report['negotiated_retries']} retries, "
+            f"{resilience_report['degraded_rounds']} degraded)"
+        )
+
     # Noise self-diagnosis: spreads over the raw passes plus the load
     # averages bracketing each side.  The bench's own process keeps a 1-core
     # box at load ~1; sustained load beyond ~1.8 means a foreign process was
@@ -829,6 +889,9 @@ def main() -> int:
         # codepoints) during the timed passes — per-row regex work, the
         # third and finest host-path class.
         "fold_hazard_frac": fold_hazard_frac,
+        # Fault-free A/B of the negotiated multi-host fault guard (docs/s
+        # with the per-round verdict protocol on vs off) + its counters.
+        **({"resilience": resilience_report} if resilience_report else {}),
     }
     if probe_failures:
         result["probe_failures"] = probe_failures

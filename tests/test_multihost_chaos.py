@@ -1,0 +1,639 @@
+"""Multi-host chaos suite: negotiated resilience, shard merge, atomic commit.
+
+Three layers, mirroring how the machinery can fail:
+
+* **Unit** (fast, tier-1): `NegotiatedGuard` verdict/retry/degrade/latch
+  semantics with injected dispatch/fetch/sleep, `detect_stale_shards`,
+  `merge_shard_files` commit discipline, `arm_from_env` parsing + rank
+  gating.
+* **Subprocess** (fast-ish, tier-1): a SIGKILL mid-merge must leave every
+  final Parquet either absent (shards intact, tmp at worst) or complete —
+  never truncated; and a `num_processes` / `jax.process_count()` mismatch
+  must fail fast naming both numbers instead of hanging in an allgather.
+* **2-process chaos** (slow): real coordinated CLI runs with
+  ``TEXTBLAST_FAULTS`` armed on ONE host only — a transient device fault
+  completes byte-identical to fault-free, a persistent fault degrades
+  rounds to the host oracle on all hosts, dead-letter shards merge into one
+  ``--errors-file``, and stale shards fail the gang fast until ``--force``.
+
+The spawn helpers are standalone copies of tests/test_multihost.py's (same
+env contract: forced CPU platform, 4 forced devices per process) extended
+with per-process env and extra CLI args — importing across test modules
+would couple the suites' lifecycles.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import textwrap
+import time
+from pathlib import Path
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from textblaster_tpu.config.pipeline import (
+    ResilienceConfig,
+    parse_pipeline_config,
+)
+from textblaster_tpu.data_model import ProcessingOutcome, TextDocument
+from textblaster_tpu.orchestration import process_documents_host
+from textblaster_tpu.parallel.multihost import (
+    detect_stale_shards,
+    merge_shard_files,
+)
+from textblaster_tpu.pipeline_builder import build_pipeline_from_config
+from textblaster_tpu.resilience import NegotiatedGuard, arm_from_env
+from textblaster_tpu.resilience.faults import FaultInjector
+from textblaster_tpu.utils.metrics import METRICS
+
+REPO = Path(__file__).parent.parent
+
+YAML = """
+pipeline:
+  - type: LanguageDetectionFilter
+    min_confidence: 0.5
+    allowed_languages: [ "dan", "eng" ]
+  - type: GopherRepetitionFilter
+    dup_line_frac: 0.3
+    top_n_grams: [[2, 0.25]]
+    dup_n_grams: [[5, 0.15]]
+  - type: GopherQualityFilter
+    min_doc_words: 4
+    min_stop_words: 1
+    stop_words: [ "og", "the", "er", "i" ]
+  - type: FineWebQualityFilter
+    line_punct_thr: 0.1
+    line_punct_exclude_zero: false
+    short_line_thr: 0.95
+    short_line_length: 8
+    char_duplicates_ratio: 0.5
+    new_line_ratio: 0.5
+"""
+
+
+def _docs():
+    base = [
+        "Det er en god dag i dag, og vi skal ud at gå en lang tur i skoven nu.",
+        "The quick brown fox jumps over the lazy dog and the old stone bridge.",
+        "Samme linje her igen.\n" * 6,
+        "kort.",
+        "Endnu en dansk tekst om vejret, og den er ganske lang og fin at læse.",
+        "Vi mødes nede ved havnen i morgen, og så sejler vi ud på vandet.",
+        ("En meget lang dansk tekst om byen og havnen og vejret, og den "
+         "bliver ved i mange ord. ") * 12,
+    ]
+    rng = np.random.default_rng(11)
+    docs = []
+    for i in range(48):
+        t = base[i % len(base)]
+        if rng.random() < 0.2:
+            t = t + " Og lidt mere tekst til sidst her."
+        docs.append(TextDocument(id=f"mh-{i}", source="s", content=t))
+    return docs
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_cli(tmp_path, docs, yaml_text, buckets="512,2048", timeout=560,
+               extra_args=(), extra_env=None, per_proc_env=None,
+               null_text_rows=()):
+    """Run the 2-process coordinated CLI to completion.
+
+    ``extra_env`` applies to both ranks, ``per_proc_env[pid]`` to one;
+    ``null_text_rows`` nulls those input text cells (each becomes a per-row
+    read error — the deterministic dead-letter generator)."""
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(yaml_text, encoding="utf-8")
+    inp = tmp_path / "input.parquet"
+    nulls = set(null_text_rows)
+    pq.write_table(
+        pa.table(
+            {
+                "id": [d.id for d in docs],
+                "text": [
+                    None if i in nulls else d.content
+                    for i, d in enumerate(docs)
+                ],
+                "source": [d.source for d in docs],
+            }
+        ),
+        inp,
+    )
+    out = tmp_path / "kept.parquet"
+    exc = tmp_path / "excluded.parquet"
+    port = _free_port()
+    procs = []
+    try:
+        for pid in (0, 1):
+            env = {
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+                "PATH": "/usr/bin:/bin:/usr/local/bin",
+                "HOME": "/root",
+            }
+            env.update(extra_env or {})
+            env.update((per_proc_env or {}).get(pid, {}))
+            procs.append(
+                subprocess.Popen(
+                    [
+                        sys.executable, "-m", "textblaster_tpu.cli", "run",
+                        "--coordinator", f"localhost:{port}",
+                        "--num-processes", "2",
+                        "--process-id", str(pid),
+                        "-i", str(inp),
+                        "-o", str(out),
+                        "-e", str(exc),
+                        "-c", str(cfg),
+                        "--buckets", buckets,
+                        "--quiet",
+                        *extra_args,
+                    ],
+                    cwd=str(REPO),
+                    env=env,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT,
+                    text=True,
+                )
+            )
+        outputs = []
+        for p in procs:
+            o, _ = p.communicate(timeout=timeout)
+            outputs.append(o)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return procs, outputs, out, exc
+
+
+def _assert_matches_oracle(yaml_text, docs, out, exc):
+    def rows(path):
+        t = pq.read_table(path).to_pylist()
+        return {
+            r["id"]: (r["text"], json.loads(r["metadata"]) if r["metadata"] else {})
+            for r in t
+        }
+
+    kept, excluded = rows(out), rows(exc)
+    assert not (set(kept) & set(excluded))
+    config = parse_pipeline_config(yaml_text)
+    host_kept, host_exc = {}, {}
+    for o in process_documents_host(
+        build_pipeline_from_config(config), iter([d.copy() for d in docs])
+    ):
+        d = o.document
+        if o.kind == ProcessingOutcome.SUCCESS:
+            host_kept[d.id] = (d.content, d.metadata)
+        elif o.kind == ProcessingOutcome.FILTERED:
+            host_exc[d.id] = (d.content, d.metadata)
+    assert set(kept) == set(host_kept)
+    assert set(excluded) == set(host_exc)
+    for k, v in host_kept.items():
+        assert kept[k] == v, k
+    for k, v in host_exc.items():
+        assert excluded[k] == v, k
+
+
+# --- NegotiatedGuard units ---------------------------------------------------
+
+
+def _mk_guard(buckets=(512,), max_retries=2, threshold=2):
+    rc = ResilienceConfig(
+        max_retries=max_retries,
+        backoff_base_s=0.01,
+        backoff_max_s=1.0,
+        backoff_multiplier=2.0,
+        breaker_threshold=threshold,
+    )
+    sleeps = []
+    return NegotiatedGuard(rc, buckets=buckets, sleep=sleeps.append), sleeps
+
+
+@pytest.mark.chaos
+def test_negotiated_guard_retries_then_succeeds():
+    guard, sleeps = _mk_guard()
+    before = METRICS.get("resilience_negotiated_retries_total")
+    calls = []
+
+    def dispatch():
+        calls.append(1)
+        if len(calls) <= 2:
+            raise OSError("transient launch failure")
+        return "out"
+
+    stats = guard.run_round(512, dispatch, lambda out: {"ok": np.ones(1)})
+    assert stats is not None and len(calls) == 3
+    # Zero-jitter shared schedule: exact backoffs, identical on every host.
+    assert sleeps == [0.01, 0.02]
+    assert METRICS.get("resilience_negotiated_retries_total") - before == 2
+    assert not guard.bucket_degraded(512)
+
+
+@pytest.mark.chaos
+def test_negotiated_guard_fetch_faults_also_negotiated():
+    guard, _sleeps = _mk_guard()
+    fetches = []
+
+    def fetch(out):
+        fetches.append(1)
+        if len(fetches) == 1:
+            raise TimeoutError("device transfer stalled")
+        return {"ok": np.ones(1)}
+
+    stats = guard.run_round(512, lambda: "out", fetch)
+    assert stats is not None and len(fetches) == 2
+
+
+@pytest.mark.chaos
+def test_negotiated_guard_degrades_then_breaker_latches():
+    guard, sleeps = _mk_guard(max_retries=2, threshold=2)
+    before = METRICS.get("resilience_negotiated_degraded_rounds_total")
+
+    def dispatch():
+        raise OSError("persistent outage")
+
+    assert guard.run_round(512, dispatch, lambda out: {}) is None
+    assert len(sleeps) == 2  # full retry budget spent before degrading
+    assert not guard.bucket_degraded(512)  # one failure, threshold 2
+    assert guard.run_round(512, dispatch, lambda out: {}) is None
+    assert guard.bucket_degraded(512)  # latched: no cooldown recovery
+    assert (
+        METRICS.get("resilience_negotiated_degraded_rounds_total") - before
+        == 2
+    )
+
+
+@pytest.mark.chaos
+def test_negotiated_guard_fatal_error_propagates():
+    guard, sleeps = _mk_guard()
+
+    def dispatch():
+        raise ValueError("deterministic bug — retrying cannot help")
+
+    with pytest.raises(ValueError):
+        guard.run_round(512, dispatch, lambda out: {})
+    assert sleeps == []  # no retries were attempted
+
+
+@pytest.mark.chaos
+def test_negotiated_guard_uses_inflight_without_dispatch():
+    guard, _sleeps = _mk_guard()
+
+    def dispatch():
+        pytest.fail("overlapped round must resolve from the inflight tree")
+
+    stats = guard.run_round(
+        512, dispatch, lambda out: {"ok": np.ones(1)}, inflight=object()
+    )
+    assert stats is not None
+
+
+@pytest.mark.chaos
+def test_negotiated_guard_launch_fault_skips_straight_to_retry():
+    guard, _sleeps = _mk_guard()
+    calls = []
+
+    def dispatch():
+        calls.append(1)
+        return "out"
+
+    stats = guard.run_round(
+        512, dispatch, lambda out: {"ok": np.ones(1)},
+        inflight=None, launch_fault=True,
+    )
+    # The captured launch fault consumed attempt 1; the negotiated retry
+    # re-dispatched once and succeeded.
+    assert stats is not None and len(calls) == 1
+
+
+# --- arm_from_env ------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_arm_from_env_parses_and_fires():
+    inj = FaultInjector()
+    n = arm_from_env(
+        env={"TEXTBLAST_FAULTS": "multihost.round:after=1:times=2"},
+        injector=inj,
+    )
+    assert n == 1 and inj.active()
+    inj.fire("multihost.round")  # after=1: first fire passes
+    with pytest.raises(OSError, match="multihost.round"):
+        inj.fire("multihost.round")
+    with pytest.raises(OSError):
+        inj.fire("multihost.round")
+    inj.fire("multihost.round")  # times=2 exhausted
+    assert inj.fired("multihost.round") == 2
+
+
+@pytest.mark.chaos
+def test_arm_from_env_rank_gating_and_validation():
+    env = {
+        "TEXTBLAST_FAULTS": "multihost.round",
+        "TEXTBLAST_FAULTS_PROCESS": "1",
+    }
+    assert arm_from_env(env=env, process_id=0, injector=FaultInjector()) == 0
+    assert arm_from_env(env=env, process_id=1, injector=FaultInjector()) == 1
+    assert arm_from_env(env={}, injector=FaultInjector()) == 0
+    # Multiple entries, non-default exception type.
+    inj = FaultInjector()
+    n = arm_from_env(
+        env={"TEXTBLAST_FAULTS": "read.batch;device.execute:exc=TimeoutError"},
+        injector=inj,
+    )
+    assert n == 2
+    with pytest.raises(ValueError):
+        arm_from_env(
+            env={"TEXTBLAST_FAULTS": "x:exc=SystemExit"},
+            injector=FaultInjector(),
+        )
+    with pytest.raises(ValueError):
+        arm_from_env(
+            env={"TEXTBLAST_FAULTS": "x:bogus=1"}, injector=FaultInjector()
+        )
+
+
+# --- stale-shard detection & atomic merge ------------------------------------
+
+
+def _write_shard(path: Path, ids, row_group_size=None) -> None:
+    t = pa.table({"id": list(ids), "text": [f"t-{i}" for i in ids]})
+    pq.write_table(t, path, row_group_size=row_group_size)
+
+
+@pytest.mark.chaos
+def test_detect_stale_shards(tmp_path: Path):
+    kept = tmp_path / "kept.parquet"
+    exc = tmp_path / "excluded.parquet"
+    for i in range(2):  # this run's own shards: not stale
+        _write_shard(Path(f"{kept}.shard{i}"), [i])
+    stale7 = Path(f"{kept}.shard7")  # a crashed 8-process run's leftover
+    stale2 = Path(f"{exc}.shard2")
+    _write_shard(stale7, [7])
+    _write_shard(stale2, [2])
+    assert detect_stale_shards([str(kept), str(exc)], 2) == sorted(
+        [str(stale7), str(stale2)]
+    )
+    # With 8 expected processes both leftovers are this run's own slots.
+    assert detect_stale_shards([str(kept), str(exc)], 8) == []
+    assert detect_stale_shards([str(tmp_path / "other.parquet")], 2) == []
+
+
+@pytest.mark.chaos
+def test_merge_shard_files_commits_atomically(tmp_path: Path):
+    kept = tmp_path / "kept.parquet"
+    exc = tmp_path / "excluded.parquet"
+    pairs = []
+    for final, base in ((kept, 0), (exc, 100)):
+        shards = [f"{final}.shard{i}" for i in range(2)]
+        for i, s in enumerate(shards):
+            _write_shard(Path(s), range(base + 10 * i, base + 10 * i + 10))
+        pairs.append((str(final), shards))
+    before = METRICS.get("multihost_merge_commits_total")
+    merge_shard_files(pairs)
+    assert METRICS.get("multihost_merge_commits_total") - before == 2
+    for final, base in ((kept, 0), (exc, 100)):
+        got = pq.read_table(final).column("id").to_pylist()
+        assert got == list(range(base, base + 20))  # shard order preserved
+        assert not os.path.exists(f"{final}.tmp")
+        assert not list(tmp_path.glob(f"{final.name}.shard*"))
+
+
+_KILL_MERGE_CHILD = textwrap.dedent(
+    """
+    import json, sys, time
+    import pyarrow.parquet as pq
+    from textblaster_tpu.parallel.multihost import merge_shard_files
+
+    pairs = json.loads(sys.argv[1])
+    _orig = pq.ParquetWriter.write_table
+    def _slow(self, table, *a, **k):
+        time.sleep(0.15)
+        return _orig(self, table, *a, **k)
+    pq.ParquetWriter.write_table = _slow
+    print("MERGE_START", flush=True)
+    merge_shard_files(pairs)
+    print("MERGE_DONE", flush=True)
+    """
+)
+
+
+@pytest.mark.chaos
+def test_sigkill_mid_merge_leaves_no_truncated_final(tmp_path: Path):
+    """The atomic-commit guarantee, verified the hard way: SIGKILL while the
+    merge is streaming row groups.  Every final must be absent (shards
+    intact, tmp at worst) or complete — and a plain re-merge recovers."""
+    kept = tmp_path / "kept.parquet"
+    exc = tmp_path / "excluded.parquet"
+    pairs = []
+    for final, base in ((kept, 0), (exc, 1000)):
+        shards = [f"{final}.shard{i}" for i in range(2)]
+        for i, s in enumerate(shards):
+            # Several row groups per shard so the kill lands mid-stream.
+            _write_shard(
+                Path(s), range(base + 50 * i, base + 50 * i + 50),
+                row_group_size=10,
+            )
+        pairs.append((str(final), shards))
+    script = tmp_path / "merge_child.py"
+    script.write_text(_KILL_MERGE_CHILD, encoding="utf-8")
+    proc = subprocess.Popen(
+        [sys.executable, str(script), json.dumps(pairs)],
+        cwd=str(REPO),
+        env={
+            "JAX_PLATFORMS": "cpu",
+            "PATH": "/usr/bin:/bin:/usr/local/bin",
+            "HOME": "/root",
+            "PYTHONPATH": str(REPO),
+        },
+        stdout=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        line = proc.stdout.readline()  # blocks through the jax import
+        assert "MERGE_START" in line, line
+        time.sleep(0.6)  # ~4 of 10 slowed row-group writes into final 1
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == -signal.SIGKILL
+    for final, shards in pairs:
+        if os.path.exists(final):
+            # Rename landed => the final must be COMPLETE, never truncated.
+            assert len(pq.read_table(final)) == 100
+        for s in shards:  # deletion only starts after every rename lands
+            assert os.path.exists(s), s
+    # Recovery is a plain re-merge of the intact shards.
+    merge_shard_files(pairs)
+    for final, shards in pairs:
+        assert len(pq.read_table(final)) == 100
+        assert not os.path.exists(f"{final}.tmp")
+        for s in shards:
+            assert not os.path.exists(s)
+
+
+_MISMATCH_CHILD = textwrap.dedent(
+    """
+    import sys
+    import jax
+    jax.distributed.initialize(sys.argv[1], num_processes=1, process_id=0)
+    from textblaster_tpu.errors import PipelineError
+    from textblaster_tpu.parallel.multihost import run_multihost
+    try:
+        run_multihost(
+            None, "in.parquet", "out.parquet", "exc.parquet",
+            coordinator=sys.argv[1], num_processes=2, process_id=0,
+        )
+    except PipelineError as e:
+        print(f"MISMATCH: {e}", flush=True)
+        sys.exit(7)
+    sys.exit(1)
+    """
+)
+
+
+@pytest.mark.chaos
+def test_num_processes_mismatch_fails_fast(tmp_path: Path):
+    """jax.distributed already initialized with a different topology:
+    ``initialize()`` returns early, and without the early assert the
+    mismatch used to surface as a hang or shape error deep in allgather."""
+    script = tmp_path / "mismatch_child.py"
+    script.write_text(_MISMATCH_CHILD, encoding="utf-8")
+    proc = subprocess.run(
+        [sys.executable, str(script), f"localhost:{_free_port()}"],
+        cwd=str(REPO),
+        env={
+            "JAX_PLATFORMS": "cpu",
+            "PATH": "/usr/bin:/bin:/usr/local/bin",
+            "HOME": "/root",
+            "PYTHONPATH": str(REPO),
+        },
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 7, proc.stdout + proc.stderr
+    assert "--num-processes 2" in proc.stdout
+    assert "jax.process_count()=1" in proc.stdout
+
+
+# --- 2-process chaos runs ----------------------------------------------------
+
+
+_NEG_LINE = re.compile(
+    r"Negotiated resilience: (\d+) jointly retried rounds, "
+    r"(\d+) rounds degraded to the host oracle"
+)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_transient_fault_one_host_completes_with_parity(tmp_path: Path):
+    """A transient device fault on host 1 only: the job must complete with
+    outcomes identical to fault-free (negotiated retry, no teardown)."""
+    docs = _docs()
+    procs, outputs, out, exc = _spawn_cli(
+        tmp_path, docs, YAML,
+        extra_env={
+            "TEXTBLAST_FAULTS": "multihost.round:after=1:times=2",
+            "TEXTBLAST_FAULTS_PROCESS": "1",
+        },
+    )
+    for p, o in zip(procs, outputs):
+        assert p.returncode == 0, o[-2000:]
+    assert not list(tmp_path.glob("*.shard*"))
+    _assert_matches_oracle(YAML, docs, out, exc)
+    # The negotiated counters are identical on every host (allgathered
+    # verdicts), so BOTH processes report the joint retries.
+    for o in outputs:
+        m = _NEG_LINE.search(o)
+        assert m, o[-2000:]
+        assert int(m.group(1)) > 0  # retried
+        assert int(m.group(2)) == 0  # nothing degraded
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_persistent_fault_degrades_jointly_with_parity(tmp_path: Path):
+    """A persistent fault on host 1: every affected round must degrade to
+    the host oracle on ALL hosts (counted in metrics), outcomes still
+    identical to fault-free."""
+    docs = _docs()
+    procs, outputs, out, exc = _spawn_cli(
+        tmp_path, docs, YAML,
+        extra_env={
+            "TEXTBLAST_FAULTS": "multihost.round:times=100000",
+            "TEXTBLAST_FAULTS_PROCESS": "1",
+        },
+    )
+    for p, o in zip(procs, outputs):
+        assert p.returncode == 0, o[-2000:]
+    _assert_matches_oracle(YAML, docs, out, exc)
+    for o in outputs:
+        m = _NEG_LINE.search(o)
+        assert m, o[-2000:]
+        assert int(m.group(2)) > 0  # degraded rounds landed in metrics
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_deadletter_shards_merge_into_one_errors_file(tmp_path: Path):
+    """--errors-file now works with --coordinator: each host writes
+    `<errors>.shard{i}`, process 0 merges them like kept/excluded."""
+    docs = _docs()
+    nulls = {3, 40}  # one unreadable row in each host's stripe
+    errs = tmp_path / "errors.parquet"
+    procs, outputs, out, exc = _spawn_cli(
+        tmp_path, docs, YAML,
+        extra_args=("--errors-file", str(errs)),
+        null_text_rows=nulls,
+    )
+    for p, o in zip(procs, outputs):
+        assert p.returncode == 0, o[-2000:]
+    assert errs.exists()
+    assert not list(tmp_path.glob("*.shard*"))
+    rows = pq.read_table(errs).to_pylist()
+    assert len(rows) == len(nulls)
+    assert all(r["step"] == "read" for r in rows)
+    assert all("null text" in r["reason"] for r in rows)
+    # The readable rows still flow to kept/excluded, matching the oracle.
+    alive = [d for i, d in enumerate(docs) if i not in nulls]
+    _assert_matches_oracle(YAML, alive, out, exc)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_stale_shards_fail_fast_then_force_recovers(tmp_path: Path):
+    """A crashed 8-process run's orphan shard must fail the gang fast (every
+    process, before joining the coordinator) — and --force clears it."""
+    docs = _docs()
+    stale = tmp_path / "kept.parquet.shard7"
+    _write_shard(stale, [7])
+    procs, outputs, out, exc = _spawn_cli(tmp_path, docs, YAML, timeout=120)
+    for p, o in zip(procs, outputs):
+        assert p.returncode != 0
+        assert "kept.parquet.shard7" in o, o[-2000:]
+    assert stale.exists()  # fail-fast does not destroy evidence
+    procs, outputs, out, exc = _spawn_cli(
+        tmp_path, docs, YAML, extra_args=("--force",)
+    )
+    for p, o in zip(procs, outputs):
+        assert p.returncode == 0, o[-2000:]
+    assert not stale.exists()
+    assert not list(tmp_path.glob("*.shard*"))
+    _assert_matches_oracle(YAML, docs, out, exc)

@@ -110,6 +110,11 @@ def build_parser() -> argparse.ArgumentParser:
                      help="Total participating processes (with --coordinator)")
     run.add_argument("--process-id", type=int, default=0,
                      help="This process's rank (with --coordinator)")
+    run.add_argument("--force", action="store_true",
+                     help="With --coordinator: remove stale *.shard* "
+                          "leftovers from a previous crashed run instead of "
+                          "failing fast when they would be silently ignored "
+                          "by the final merge")
 
     val = sub.add_parser("validate-config",
                          help="Validate a pipeline configuration and exit")
@@ -203,11 +208,6 @@ def _cmd_run(args: argparse.Namespace) -> int:
         print("--coordinator requires the compiled pipeline "
               "(--backend tpu or cpu, not host)", file=sys.stderr)
         return 1
-    if args.coordinator and args.errors_file:
-        print("--errors-file is not supported with --coordinator yet "
-              "(per-host dead-letter shards are not merged)", file=sys.stderr)
-        return 1
-
     try:
         if args.coordinator:
             from .parallel.multihost import run_multihost
@@ -230,6 +230,8 @@ def _cmd_run(args: argparse.Namespace) -> int:
                 text_column=args.text_column,
                 id_column=args.id_column,
                 read_batch_size=args.batch_size,
+                errors_file=args.errors_file,
+                force=args.force,
                 **mh_kwargs,
             )
         elif args.checkpoint_dir:
@@ -297,6 +299,20 @@ def _cmd_run(args: argparse.Namespace) -> int:
         print(
             f"Dead-letter rows: {deadlettered} -> {args.errors_file} "
             "(errored + unreadable)."
+        )
+    neg_retries = int(METRICS.get("resilience_negotiated_retries_total"))
+    neg_degraded = int(
+        METRICS.get("resilience_negotiated_degraded_rounds_total")
+    )
+    if neg_retries or neg_degraded:
+        # The negotiated counters move identically on every host (the
+        # verdicts are allgathered), so each process reports the same global
+        # story.  Printed even under --quiet: a degraded round is an
+        # operational signal, not progress chatter.
+        print(
+            f"Negotiated resilience: {neg_retries} jointly retried rounds, "
+            f"{neg_degraded} rounds degraded to the host oracle.",
+            file=sys.stderr,
         )
     tripped = int(METRICS.get("resilience_breaker_trips_total"))
     if tripped:

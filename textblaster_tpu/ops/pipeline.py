@@ -1248,6 +1248,25 @@ class CompiledPipeline:
                 cps = cps.astype(np.uint16)
         return fn(cps, lengths)
 
+    def dispatch_lockstep(
+        self, batch: PackedBatch, phase: int, sharding2, sharding1
+    ) -> Dict[str, jax.Array]:
+        """Launch one multi-host lockstep round (async) from this process's
+        local rows of the global batch.
+
+        The multi-host analogue of :meth:`dispatch_batch`: the fault seam the
+        negotiated guard wraps (``FAULTS`` site ``"multihost.round"`` fires
+        here, so chaos tests can fail the launch on one host only), but the
+        arrays are assembled per-process (``make_array_from_process_local_data``
+        against the caller's global shardings) and occupancy is NOT recorded —
+        the caller records it once per round so negotiated re-dispatches don't
+        skew the telemetry."""
+        FAULTS.fire("multihost.round")
+        fn = self._fn_for(batch.max_len, phase)
+        g_cps = jax.make_array_from_process_local_data(sharding2, batch.cps)
+        g_len = jax.make_array_from_process_local_data(sharding1, batch.lengths)
+        return fn(g_cps, g_len)
+
     # --- degradation ladder -------------------------------------------------
 
     def _device_fetch(

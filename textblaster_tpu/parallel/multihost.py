@@ -27,10 +27,19 @@ reference's competing consumers onto.
 On real pods the same code runs unchanged: ``initialize()`` picks up the TPU
 coordinator, the mesh spans the slice, and ICI/DCN routing is XLA's choice —
 no NCCL/MPI analogue to manage (SURVEY.md §2.5's north-star mapping).
+
+Resilience (PR 4): each lockstep round resolves under the negotiated guard
+(:mod:`textblaster_tpu.resilience.negotiated`) — a retryable fault on any
+host triggers a jointly-negotiated retry/degradation so transient device
+faults no longer kill the job; per-host dead-letter shards merge like
+kept/excluded; and the host-0 merge commits every final atomically
+(tmp + fsync + rename via :func:`merge_shard_files`), deleting shards only
+after every rename lands.
 """
 
 from __future__ import annotations
 
+import itertools
 import math
 from typing import Iterable, List, Optional, Sequence, Tuple
 
@@ -45,9 +54,96 @@ from .mesh import DATA_AXIS, batch_sharding
 __all__ = [
     "initialize",
     "global_data_mesh",
+    "host_allgather",
+    "detect_stale_shards",
+    "merge_shard_files",
     "run_local_shard",
     "run_multihost",
 ]
+
+
+def detect_stale_shards(
+    finals: Sequence[str], num_processes: int
+) -> List[str]:
+    """``*.shard*`` siblings of ``finals`` that THIS run will not produce.
+
+    A prior crashed run with a larger ``--num-processes`` leaves orphan
+    ``<final>.shard{j}`` files (j >= num_processes); the old merge silently
+    ignored them next to fresh outputs — data loss masquerading as success.
+    Returns the sorted offenders so callers can fail fast naming them
+    (``--force`` removes them instead).  Expected shards
+    (``.shard0..shard{n-1}``) are NOT stale: this run overwrites them.
+    """
+    import glob
+
+    expected = {
+        f"{final}.shard{i}" for final in finals for i in range(num_processes)
+    }
+    stale = {
+        path
+        for final in finals
+        for path in glob.glob(glob.escape(final) + ".shard*")
+        if path not in expected
+    }
+    return sorted(stale)
+
+
+def _commit_merged(final: str, shards: Sequence[str]) -> None:
+    """Stream the shards' row groups into ``<final>.tmp``, then commit it
+    atomically: fsync the tmp, rename over ``final``, fsync the directory —
+    the checkpoint-commit discipline (checkpoint.py), so a crash at any
+    instant leaves ``final`` either absent or complete, never truncated."""
+    import os
+
+    import pyarrow.parquet as pq
+
+    from ..utils.metrics import METRICS
+
+    tmp = final + ".tmp"
+    writer = None
+    try:
+        for s in shards:
+            pf = pq.ParquetFile(s)
+            if writer is None:
+                writer = pq.ParquetWriter(tmp, pf.schema_arrow)
+            # Row-group streaming keeps the merge O(row-group) memory
+            # however large the global corpus is.
+            for g in range(pf.metadata.num_row_groups):
+                writer.write_table(pf.read_row_group(g))
+    finally:
+        if writer is not None:
+            writer.close()
+    fd = os.open(tmp, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    os.replace(tmp, final)
+    dfd = os.open(os.path.dirname(os.path.abspath(final)), os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
+    METRICS.inc("multihost_merge_commits_total")
+
+
+def merge_shard_files(
+    pairs: Sequence[Tuple[str, Sequence[str]]]
+) -> None:
+    """Commit every ``(final, shards)`` merge atomically, THEN delete shards.
+
+    Deletion only starts after the last rename has landed: a kill anywhere
+    mid-merge leaves every input shard intact, so a re-run (with ``--force``
+    to clear the re-produced finals' leftover shards if needed) loses
+    nothing.  The old in-place merge consumed shards into a final that a
+    crash left truncated — unrecoverable."""
+    import os
+
+    for final, shards in pairs:
+        _commit_merged(final, shards)
+    for _final, shards in pairs:
+        for s in shards:
+            os.remove(s)
 
 
 def initialize(
@@ -58,18 +154,90 @@ def initialize(
     ``coordinator`` is ``host:port`` of process 0 — the moral equivalent of
     the reference's ``--amqp-addr`` (utils/common.rs:15), except the
     connection carries collectives instead of JSON tasks."""
-    if jax.distributed.is_initialized():
+    if _distributed_initialized():
         return
     jax.distributed.initialize(
         coordinator, num_processes=num_processes, process_id=process_id
     )
 
 
+def _distributed_initialized() -> bool:
+    """True once this process joined a ``jax.distributed`` job.
+
+    ``jax.distributed.is_initialized`` only exists on newer jax; on older
+    versions (this container's 0.4.x included) probe the distributed state's
+    client directly instead of raising AttributeError mid-run."""
+    fn = getattr(jax.distributed, "is_initialized", None)
+    if fn is not None:
+        return bool(fn())
+    from jax._src import distributed
+
+    return getattr(distributed.global_state, "client", None) is not None
+
+
 def global_data_mesh() -> "jax.sharding.Mesh":
-    """1-D ``data`` mesh over every device of every process."""
+    """1-D ``data`` mesh over every device of every process.
+
+    Exception: on a multi-process **CPU** job the mesh covers only this
+    process's local devices.  XLA:CPU refuses to execute a computation that
+    spans processes (INVALID_ARGUMENT "Multiprocess computations aren't
+    implemented on the CPU backend"), and the compiled pipeline programs are
+    collective-free, so per-host execution under the negotiated lockstep
+    schedule — whose exchanges ride :func:`host_allgather` — is semantically
+    identical: each host's "global" batch is simply its own stripe.  On
+    accelerator backends the mesh spans the whole job as before and XLA
+    routes cross-host traffic over ICI/DCN."""
     from jax.sharding import Mesh
 
-    return Mesh(np.array(jax.devices()), (DATA_AXIS,))
+    devices = (
+        jax.local_devices()
+        if jax.process_count() > 1 and jax.default_backend() == "cpu"
+        else jax.devices()
+    )
+    return Mesh(np.array(devices), (DATA_AXIS,))
+
+
+_AG_SEQ = itertools.count()
+
+
+def host_allgather(vec: np.ndarray) -> np.ndarray:
+    """Allgather one small int vector per process; returns ``[n_proc, len]``.
+
+    Every lockstep exchange in this module (round schedules, fault verdicts,
+    merged histograms, the totals barrier) funnels through here.  On
+    accelerator backends it is ``multihost_utils.process_allgather``; on a
+    multi-process CPU job — where XLA cannot run the collective at all — the
+    same exchange rides the ``jax.distributed`` coordination-service
+    key-value store, the transport that already carries barriers and
+    heartbeats.  Callers must invoke it in lockstep (the contract this
+    module enforces anyway): a per-process sequence number keys each
+    exchange, and the blocking gets double as the barrier — no process
+    proceeds until every peer has posted its row."""
+    arr = np.asarray(vec, dtype=np.int64).ravel()
+    n = jax.process_count()
+    if n == 1:
+        return arr.reshape(1, -1)
+    if jax.default_backend() != "cpu":
+        from jax.experimental import multihost_utils
+
+        return np.asarray(
+            multihost_utils.process_allgather(arr), dtype=np.int64
+        ).reshape(n, -1)
+    from jax._src import distributed
+
+    client = distributed.global_state.client
+    seq = next(_AG_SEQ)
+    client.key_value_set(
+        f"textblast/allgather/{seq}/{jax.process_index()}",
+        ",".join(str(int(x)) for x in arr),
+    )
+    rows = []
+    for r in range(n):
+        raw = client.blocking_key_value_get(
+            f"textblast/allgather/{seq}/{r}", 300_000
+        )
+        rows.append([int(x) for x in raw.split(",")] if raw else [])
+    return np.asarray(rows, dtype=np.int64)
 
 
 def _local_stats(out: dict) -> dict:
@@ -99,14 +267,7 @@ def _negotiate_max(needed_local: np.ndarray) -> np.ndarray:
     bucket — a unilateral decision while peers enter ``fn()`` would hang the
     job until the coordinator heartbeat tears it down.  One small allgather
     makes the schedule global and deterministic."""
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-
-        needed_all = multihost_utils.process_allgather(
-            needed_local.astype(np.int32)
-        ).reshape(-1, needed_local.shape[0])
-        return needed_all.max(axis=0)
-    return needed_local.astype(np.int32)
+    return host_allgather(needed_local).max(axis=0).astype(np.int32)
 
 
 def run_local_shard(
@@ -117,6 +278,7 @@ def run_local_shard(
     mesh=None,
     pipeline=None,
     buckets: Optional[Sequence[int]] = None,
+    fault_guard: bool = True,
 ) -> List[ProcessingOutcome]:
     """Run this host's documents through the globally-sharded pipeline.
 
@@ -136,9 +298,21 @@ def run_local_shard(
     program sequence while later phases run on shrinking, repacked survivor
     batches — the device analogue of the executor short-circuit that the
     single-controller path already had.
+
+    With ``fault_guard`` (default) every round resolves under the
+    :class:`~textblaster_tpu.resilience.negotiated.NegotiatedGuard`: a
+    retryable fault on ANY host triggers a jointly-negotiated retry of the
+    round on EVERY host (shared zero-jitter backoff), then a
+    jointly-negotiated degradation of the round's documents to the host
+    oracle; a per-bucket breaker latches persistently bad buckets onto the
+    oracle for the rest of the run.  The guard's only lockstep addition is
+    one 1-int allgather per round resolution — the fault-free program
+    sequence is unchanged.
     """
     from ..ops.pipeline import CompiledPipeline, record_occupancy
     from ..orchestration import execute_processing_pipeline
+    from ..resilience.negotiated import NegotiatedGuard
+    from ..resilience.retry import classify_error
     from ..utils.metrics import METRICS
 
     from ..ops.packing import PACK_MARGIN
@@ -147,7 +321,10 @@ def run_local_shard(
         buckets = (bucket,) if bucket is not None else (2048,)
     buckets = tuple(sorted(buckets))
     mesh = mesh if mesh is not None else global_data_mesh()
-    n_proc = jax.process_count()
+    # How many processes the program's mesh spans: jax.process_count() on
+    # accelerators, 1 under the multi-process-CPU local-mesh fallback
+    # (global_data_mesh) where each host runs its own full-width program.
+    n_proc = len({d.process_index for d in mesh.devices.flat})
     if pipeline is None:
         pipeline = CompiledPipeline(config, buckets=buckets, mesh=mesh)
     # Per-bucket local row counts: each host feeds its 1/n_proc stripe of the
@@ -192,6 +369,46 @@ def run_local_shard(
     sh2 = batch_sharding(mesh, 2)
     sh1 = batch_sharding(mesh, 1)
 
+    guard = NegotiatedGuard(config.resilience, buckets=buckets) if fault_guard else None
+    degraded: List[TextDocument] = []
+
+    def launch(local, ph):
+        """Guarded async launch.  Returns ``(out, launch_fault)``: a
+        retryable launch failure is captured, not raised — the verdict has
+        to convene at resolve time so every host takes the same branch."""
+        if guard is None:
+            return pipeline.dispatch_lockstep(local, ph, sh2, sh1), False
+        try:
+            return pipeline.dispatch_lockstep(local, ph, sh2, sh1), False
+        except BaseException as e:  # noqa: BLE001 — classifier decides
+            if classify_error(e) != "retryable":
+                raise
+            return None, True
+
+    def resolve(entry, outcomes, survivors):
+        """Block for one in-flight round and assemble it — under the
+        negotiated verdict protocol when the guard is on."""
+        local, ph = entry["batch"], entry["phase"]
+        if guard is None:
+            stats = _local_stats(entry["out"])
+        else:
+            b = entry["bucket"]
+            stats = guard.run_round(
+                b,
+                dispatch=lambda: pipeline.dispatch_lockstep(local, ph, sh2, sh1),
+                fetch=_local_stats,
+                inflight=entry["out"],
+                launch_fault=entry["fault"],
+            )
+            if stats is None:
+                # Jointly degraded: every host routes this round's chunk to
+                # the host oracle; none re-enters the program.
+                degraded.extend(local.docs)
+                return
+        po, alive = pipeline.assemble_phase(local, stats, ph)
+        outcomes.extend(po)
+        survivors.extend(alive)
+
     outcomes: List[ProcessingOutcome] = []
     n_phases = len(pipeline.phases)
     for phase in range(n_phases):
@@ -207,30 +424,30 @@ def run_local_shard(
             )
 
         survivors: List[TextDocument] = []
-        pending = None  # (local_batch, device_out): one round in flight
+        pending = None  # one guarded round in flight (dict entry)
         for b, n_rounds in zip(buckets, schedule):
-            fn = pipeline._fn_for(b, phase)
             local_batch = local_for[b]
             for r in range(int(n_rounds)):
                 chunk = current[b][r * local_batch : (r + 1) * local_batch]
+                if guard is not None and guard.bucket_degraded(b):
+                    # Breaker latched on negotiated verdicts, so every host
+                    # reaches the same conclusion at the same round and the
+                    # dispatch is skipped jointly — lockstep preserved
+                    # without touching the device.
+                    METRICS.inc("resilience_negotiated_degraded_rounds_total")
+                    degraded.extend(chunk)
+                    continue
                 local = pack_documents(chunk, batch_size=local_batch, max_len=b)
                 record_occupancy(local)
-                g_cps = jax.make_array_from_process_local_data(sh2, local.cps)
-                g_len = jax.make_array_from_process_local_data(sh1, local.lengths)
-                out = fn(g_cps, g_len)
+                out, fault = launch(local, phase)
                 if pending is not None:
-                    po, alive = pipeline.assemble_phase(
-                        pending[0], _local_stats(pending[1]), phase
-                    )
-                    outcomes.extend(po)
-                    survivors.extend(alive)
-                pending = (local, out)
+                    resolve(pending, outcomes, survivors)
+                pending = {
+                    "batch": local, "bucket": b, "phase": phase,
+                    "out": out, "fault": fault,
+                }
         if pending is not None:
-            po, alive = pipeline.assemble_phase(
-                pending[0], _local_stats(pending[1]), phase
-            )
-            outcomes.extend(po)
-            survivors.extend(alive)
+            resolve(pending, outcomes, survivors)
         if phase == n_phases - 1:
             break
         # Survivor content may have been rewritten (C4) — repack by the
@@ -244,6 +461,11 @@ def run_local_shard(
         o = execute_processing_pipeline(pipeline.host_executor, d)
         if o is not None:
             outcomes.append(o)
+    if degraded:
+        # Degraded rounds re-run start to finish on the bit-exact host
+        # oracle (mid-phase re-stamp contract, ops/pipeline.py _host_rerun),
+        # so outcomes stay byte-identical to a fault-free run.
+        outcomes.extend(pipeline._host_rerun(degraded))
     return outcomes
 
 
@@ -262,21 +484,32 @@ def run_multihost(
     read_batch_size: int = 1024,
     device_batch: Optional[int] = None,
     auto_geometry: bool = False,
+    errors_file: Optional[str] = None,
+    force: bool = False,
 ):
     """Production multi-host entry (``textblast run --coordinator ...``).
 
     Each process reads its contiguous row stripe of ``input_file`` (the
     static shard assignment SURVEY.md §2.5 maps the task queue onto), runs
     the negotiated lockstep schedule, and writes a per-host
-    ``<output>.shard<i>`` / ``<excluded>.shard<i>`` Parquet pair.  After a
-    global barrier, process 0 concatenates the shards into the final
-    kept/excluded files (the results-queue aggregation analogue,
-    producer_logic.rs:109-196) and deletes the shard files.
+    ``<output>.shard<i>`` / ``<excluded>.shard<i>`` Parquet pair (plus an
+    ``<errors>.shard<i>`` dead-letter shard when ``errors_file`` is given —
+    the per-host slice of PR 1's sink).  After a global barrier, process 0
+    merges each shard set into its final file **atomically**
+    (:func:`merge_shard_files`: tmp + fsync + rename, shards deleted only
+    after every rename lands) — the results-queue aggregation analogue,
+    producer_logic.rs:109-196.  Stale ``*.shard*`` leftovers from a crashed
+    run with different ``--num-processes`` fail the run fast on every
+    process unless ``force`` removes them.
 
     Returns an ``AggregationResult``: global totals on process 0 (after the
     merge), local totals elsewhere.
 
-    Failure behavior (measured, tests/test_multihost.py): if a process dies
+    Failure behavior (measured, tests/test_multihost.py +
+    tests/test_multihost_chaos.py): a *retryable device fault* on any host
+    no longer kills the job — ``run_local_shard``'s negotiated guard retries
+    the round jointly on every host and, past the budget, degrades it to the
+    host oracle jointly (outcomes stay byte-identical).  If a process *dies*
     mid-run, survivors do NOT hang on the next allgather — the jax
     coordination service detects the missed heartbeats (~90 s) and
     propagates UNAVAILABLE to every healthy task, which exits nonzero with
@@ -296,14 +529,60 @@ def run_multihost(
         aggregate_results_from_stream,
         read_documents,
     )
+    from ..resilience import DeadLetterSink
+    from ..resilience.faults import arm_from_env
+    from ..utils.metrics import METRICS
+
+    finals = [output_file, excluded_file]
+    if errors_file is not None:
+        finals.append(errors_file)
+    stale = detect_stale_shards(finals, num_processes)
+    if stale:
+        if not force:
+            # Checked on EVERY process before joining the coordinator, so
+            # the whole gang exits fast instead of one host discovering the
+            # problem after the run.
+            raise PipelineError(
+                "stale shard files from a previous run would be ignored by "
+                f"the merge: {', '.join(stale)} — remove them or pass "
+                "--force to overwrite"
+            )
+        for s in stale:
+            try:
+                os.remove(s)
+            except FileNotFoundError:
+                pass  # a peer on a shared filesystem got there first
+            else:
+                METRICS.inc("multihost_stale_shards_removed_total")
 
     initialize(coordinator, num_processes, process_id)
+    if jax.process_count() != num_processes:
+        # Without this, a topology mismatch (typically jax.distributed
+        # already initialized with different numbers) surfaces as a hang or
+        # a shape error deep inside the first allgather.
+        raise PipelineError(
+            f"--num-processes {num_processes} does not match the "
+            f"initialized distributed runtime "
+            f"(jax.process_count()={jax.process_count()}); all processes "
+            "must be launched with the same topology, and an existing "
+            "jax.distributed initialization cannot be re-shaped"
+        )
+    arm_from_env(process_id=process_id)
     mesh = global_data_mesh()
 
     n_rows = pq.ParquetFile(input_file).metadata.num_rows
     stride = math.ceil(n_rows / max(num_processes, 1))
     skip = min(process_id * stride, n_rows)
     take = max(0, min(stride, n_rows - skip))
+
+    # Per-host dead-letter shard, merged by process 0 exactly like
+    # kept/excluded.  Created eagerly (DeadLetterSink writes the empty file
+    # up front) so the merge never races a host that recorded nothing.
+    deadletter = (
+        DeadLetterSink(f"{errors_file}.shard{process_id}")
+        if errors_file is not None
+        else None
+    )
 
     read_errors = 0
     docs: List[TextDocument] = []
@@ -317,6 +596,8 @@ def run_multihost(
     for item in islice(stream, take):  # one stream item per Parquet row
         if isinstance(item, PipelineError):
             read_errors += 1
+            if deadletter is not None:
+                deadletter.record_read_error(item)
         else:
             docs.append(item)
 
@@ -331,20 +612,12 @@ def run_multihost(
         # round schedule (which depends on buckets and batch sizes) stays in
         # agreement without shipping raw lengths across hosts.
         from ..ops.geometry import (
-            HIST_BIN_EDGES,
             geometry_from_histogram,
             length_histogram,
         )
 
         hist = length_histogram([len(d.content) for d in docs])
-        if num_processes > 1:
-            from jax.experimental import multihost_utils
-
-            hist = (
-                multihost_utils.process_allgather(hist.astype(np.int64))
-                .reshape(-1, len(HIST_BIN_EDGES))
-                .sum(axis=0)
-            )
+        hist = host_allgather(hist).sum(axis=0)
         if hist.sum() > 0:
             geometry = geometry_from_histogram(
                 hist, backend=jax.default_backend()
@@ -354,14 +627,22 @@ def run_multihost(
         config, buckets=tuple(sorted(buckets)), batch_size=device_batch,
         mesh=mesh, geometry=geometry,
     )
-    outcomes = run_local_shard(
-        config, docs, buckets=pipeline.geometry.buckets, mesh=mesh,
-        pipeline=pipeline,
-    )
+    try:
+        outcomes = run_local_shard(
+            config, docs, buckets=pipeline.geometry.buckets, mesh=mesh,
+            pipeline=pipeline,
+        )
 
-    shard_out = f"{output_file}.shard{process_id}"
-    shard_exc = f"{excluded_file}.shard{process_id}"
-    result = aggregate_results_from_stream(iter(outcomes), shard_out, shard_exc)
+        shard_out = f"{output_file}.shard{process_id}"
+        shard_exc = f"{excluded_file}.shard{process_id}"
+        result = aggregate_results_from_stream(
+            iter(outcomes), shard_out, shard_exc, deadletter=deadletter
+        )
+    finally:
+        # The shard must be complete on disk before the totals barrier
+        # releases process 0 into the merge.
+        if deadletter is not None:
+            deadletter.close()
     result.read_errors = read_errors
 
     totals = np.array(
@@ -369,35 +650,18 @@ def run_multihost(
          result.read_errors],
         dtype=np.int64,
     )
-    if num_processes > 1:
-        from jax.experimental import multihost_utils
-
-        # Barrier doubling as the totals exchange: every process must have
-        # closed its shard files before process 0 merges.
-        all_totals = multihost_utils.process_allgather(totals).reshape(-1, 5)
-    else:
-        all_totals = totals.reshape(1, 5)
+    # Barrier doubling as the totals exchange: every process must have
+    # closed its shard files before process 0 merges (host_allgather's
+    # blocking gets release only once every peer has posted).
+    all_totals = host_allgather(totals).reshape(-1, 5)
 
     if process_id == 0:
-        for final, shards in (
-            (output_file, [f"{output_file}.shard{i}" for i in range(num_processes)]),
-            (excluded_file, [f"{excluded_file}.shard{i}" for i in range(num_processes)]),
-        ):
-            # Stream row groups shard by shard: the merge stays O(row-group)
-            # memory however large the global corpus is.
-            writer = None
-            try:
-                for s in shards:
-                    pf = pq.ParquetFile(s)
-                    if writer is None:
-                        writer = pq.ParquetWriter(final, pf.schema_arrow)
-                    for g in range(pf.metadata.num_row_groups):
-                        writer.write_table(pf.read_row_group(g))
-            finally:
-                if writer is not None:
-                    writer.close()
-            for s in shards:
-                os.remove(s)
+        merge_shard_files(
+            [
+                (final, [f"{final}.shard{i}" for i in range(num_processes)])
+                for final in finals
+            ]
+        )
         g = all_totals.sum(axis=0)
         merged = AggregationResult()
         merged.received, merged.success, merged.filtered = int(g[0]), int(g[1]), int(g[2])
@@ -421,9 +685,14 @@ def _main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("-i", "--input-file", required=True)
     ap.add_argument("-o", "--output-file", required=True)
     ap.add_argument("-e", "--excluded-file", required=True)
+    ap.add_argument("--errors-file", default=None)
+    ap.add_argument("--text-column", default="text")
+    ap.add_argument("--id-column", default="id")
+    ap.add_argument("--read-batch-size", type=int, default=1024)
     ap.add_argument("--buckets", default="512,2048,8192")
     ap.add_argument("--device-batch", type=int, default=None)
     ap.add_argument("--auto-geometry", action="store_true")
+    ap.add_argument("--force", action="store_true")
     args = ap.parse_args(argv)
 
     config = load_pipeline_config(args.pipeline_config)
@@ -435,9 +704,14 @@ def _main(argv: Optional[Sequence[str]] = None) -> int:
         coordinator=args.coordinator,
         num_processes=args.num_processes,
         process_id=args.process_id,
+        text_column=args.text_column,
+        id_column=args.id_column,
+        read_batch_size=args.read_batch_size,
         buckets=tuple(int(b) for b in args.buckets.split(",")),
         device_batch=args.device_batch,
         auto_geometry=args.auto_geometry,
+        errors_file=args.errors_file,
+        force=args.force,
     )
     print(
         f"process {args.process_id}: {result.received} outcomes "

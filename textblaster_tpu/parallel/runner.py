@@ -94,6 +94,9 @@ def run_pipeline(
     rc = getattr(config, "resilience", None)
     retry_policy = RetryPolicy.from_config(rc) if rc is not None else None
 
+    # Single-process sink writes the final file directly; the multi-host
+    # path writes per-host `<errors>.shard{i}` files instead and merges
+    # them on process 0 (parallel/multihost.py run_multihost).
     deadletter = DeadLetterSink(errors_file) if errors_file is not None else None
 
     def on_read_error(err) -> None:

@@ -20,7 +20,12 @@ gives the broker-free executor a real one:
 * :mod:`~textblaster_tpu.resilience.deadletter` — :class:`DeadLetterSink`,
   the opt-in ``--errors-file`` Parquet quarantine for Error outcomes and
   unreadable rows (the default remains the reference's neither-file
-  behavior).
+  behavior);
+* :mod:`~textblaster_tpu.resilience.negotiated` — :class:`NegotiatedGuard`,
+  the multi-host arm of the ladder: per lockstep round every host
+  allgathers a fault flag and ALL hosts jointly retry (shared zero-jitter
+  backoff), then jointly degrade the round to the host oracle, with
+  per-bucket breakers latched by the shared verdict sequence.
 """
 
 from .breaker import CircuitBreaker
@@ -30,7 +35,8 @@ from .deadletter import (
     outcome_row,
     read_error_row,
 )
-from .faults import FAULTS, FaultInjector
+from .faults import FAULTS, FaultInjector, arm_from_env
+from .negotiated import NegotiatedGuard
 from .retry import (
     RetryPolicy,
     classify_error,
@@ -44,7 +50,9 @@ __all__ = [
     "DeadLetterSink",
     "FAULTS",
     "FaultInjector",
+    "NegotiatedGuard",
     "RetryPolicy",
+    "arm_from_env",
     "classify_error",
     "is_oom_error",
     "is_retryable_error",

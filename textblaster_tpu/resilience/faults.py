@@ -12,11 +12,19 @@ Sites planted in this build:
 * ``"device.execute"``    — per device-batch dispatch
   (:meth:`textblaster_tpu.ops.pipeline.CompiledPipeline.dispatch_batch`);
 * ``"checkpoint.commit"`` — per checkpoint cursor commit
-  (:meth:`textblaster_tpu.checkpoint.CheckpointState.save`).
+  (:meth:`textblaster_tpu.checkpoint.CheckpointState.save`);
+* ``"multihost.round"``   — per multi-host lockstep round launch
+  (:meth:`textblaster_tpu.ops.pipeline.CompiledPipeline.dispatch_lockstep`).
 
 The injector is **inert by default**: with nothing armed, :meth:`fire` is a
 single attribute load + falsy check and keeps no per-call state, so
 production paths pay effectively nothing (a tier-1 guard test pins this).
+
+Multi-host chaos tests run each rank as a separate OS process, so arming
+can't happen in the test process: :func:`arm_from_env` reads a
+``TEXTBLAST_FAULTS`` spec from the environment inside the subprocess (and
+``TEXTBLAST_FAULTS_PROCESS`` gates it to one rank) — the only way to fault
+exactly one host of a real 2-process run.
 """
 
 from __future__ import annotations
@@ -25,7 +33,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Union
 
-__all__ = ["FaultInjector", "FAULTS"]
+__all__ = ["FaultInjector", "FAULTS", "arm_from_env"]
 
 ExcSpec = Union[BaseException, Callable[[], BaseException]]
 
@@ -122,3 +130,81 @@ class FaultInjector:
 
 #: The process-global injector every guarded seam fires into.
 FAULTS = FaultInjector()
+
+#: Exception types :func:`arm_from_env` may construct — an allowlist, not
+#: ``eval``: the env var names one of these, never arbitrary code.
+_ENV_EXC_TYPES = {
+    "OSError": OSError,
+    "TimeoutError": TimeoutError,
+    "RuntimeError": RuntimeError,
+    "MemoryError": MemoryError,
+}
+
+
+def arm_from_env(
+    env: Optional[Dict[str, str]] = None,
+    process_id: Optional[int] = None,
+    injector: Optional[FaultInjector] = None,
+) -> int:
+    """Arm :data:`FAULTS` from a ``TEXTBLAST_FAULTS`` environment spec.
+
+    Spec grammar (``;``-separated entries)::
+
+        site[:after=N][:times=M][:exc=Name]
+
+    e.g. ``TEXTBLAST_FAULTS="multihost.round:after=1:times=2"`` arms an
+    ``OSError`` (the default — classified retryable) on the second and third
+    fires of the lockstep-round seam.  ``exc`` must name a type in the
+    allowlist (OSError, TimeoutError, RuntimeError, MemoryError).
+
+    When ``TEXTBLAST_FAULTS_PROCESS`` is set and ``process_id`` is given,
+    arming is skipped unless they match — how a multi-host chaos test faults
+    exactly one rank of a real N-process run.  Returns the number of faults
+    armed (0 when the spec is absent or gated off).
+    """
+    import os
+
+    env = os.environ if env is None else env
+    injector = FAULTS if injector is None else injector
+    spec = env.get("TEXTBLAST_FAULTS", "").strip()
+    if not spec:
+        return 0
+    only = env.get("TEXTBLAST_FAULTS_PROCESS", "").strip()
+    if only and process_id is not None and int(only) != int(process_id):
+        return 0
+    armed = 0
+    for entry in spec.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        site, after_calls, times, exc_name = parts[0], 0, 1, "OSError"
+        for p in parts[1:]:
+            key, _, val = p.partition("=")
+            if key == "after":
+                after_calls = int(val)
+            elif key == "times":
+                times = int(val)
+            elif key == "exc":
+                exc_name = val
+            else:
+                raise ValueError(
+                    f"unknown TEXTBLAST_FAULTS option {key!r} in {entry!r}"
+                )
+        try:
+            exc_type = _ENV_EXC_TYPES[exc_name]
+        except KeyError:
+            raise ValueError(
+                f"TEXTBLAST_FAULTS exc must be one of "
+                f"{sorted(_ENV_EXC_TYPES)}, got {exc_name!r}"
+            ) from None
+        injector.inject(
+            site,
+            lambda site=site, exc_type=exc_type: exc_type(
+                f"injected fault at {site} (TEXTBLAST_FAULTS)"
+            ),
+            after_calls=after_calls,
+            times=times,
+        )
+        armed += 1
+    return armed

@@ -1,0 +1,194 @@
+"""Negotiated resilience for the lockstep multi-host SPMD path.
+
+The single-host degradation ladder (ops/pipeline.py ``_execute_packed``)
+makes *unilateral* decisions: retry this batch, split it, rerun it on the
+host oracle.  Under ``jax.distributed`` that is exactly what the lockstep
+contract forbids — every process must dispatch the same programs in the
+same order, so one host quietly re-dispatching (or skipping) a round while
+its peers move on desynchronizes the global program sequence and hangs the
+job until the coordination-service heartbeat tears it down (~90 s).
+
+This module makes the ladder's decisions *jointly*.  After every lockstep
+round each host contributes a 1-element fault flag to a small allgather
+(the same ``host_allgather`` machinery the round schedule is negotiated
+with, see ``parallel/multihost.py _negotiate_max``) and every host applies
+the identical verdict:
+
+* **any host faulted → negotiated retry**: ALL hosts re-dispatch the same
+  round — including hosts whose own attempt succeeded, because the compiled
+  program is a global SPMD execution that every process must participate
+  in.  The shared :class:`RetryPolicy` schedule runs with **jitter forced
+  to zero** so every host computes the same backoff for the same attempt
+  and the dispatch sequences stay aligned in time as well as in order.
+* **retry budget exhausted → negotiated degradation**: every host routes
+  its chunk of the round to the bit-exact host oracle.  The degraded round
+  is skipped *jointly* — agreement, not dispatch, is what lockstep
+  requires, and this is the safe form of the "pad round": a host whose
+  device cannot launch the pad program would strand its peers' in-flight
+  collectives, whereas a negotiated skip keeps the global program sequence
+  identical on every host by construction.
+* **persistent faults → negotiated breaker latch**: a per-bucket
+  :class:`CircuitBreaker` counts negotiated round failures.  Its state is
+  driven *only* by the shared verdict sequence (cooldown is pinned to 0 —
+  a wall-clock cooldown would let host clocks disagree about the state),
+  so when a bucket trips, every host latches it at the same round and
+  routes the rest of that bucket's documents to the host oracle without
+  dispatching.
+
+Residual risk, documented rather than hidden: if a compiled program carries
+cross-host collectives (XLA's choice) and one host's *launch* fails while a
+peer's succeeds, the peer's fetch can block on a collective that never
+completes — the verdict negotiation only runs after the fetch returns or
+raises.  The data-parallel filter programs this build compiles are
+collective-free (see parallel/mesh.py), so the fetch completes locally and
+the negotiation always convenes; on topologies where XLA inserts
+collectives the heartbeat teardown remains the backstop, exactly as for
+hard process death.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+from ..utils.metrics import METRICS
+from .breaker import CircuitBreaker
+from .retry import RetryPolicy, classify_error
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["NegotiatedGuard"]
+
+
+class NegotiatedGuard:
+    """Joint fault/verdict protocol for one multi-host run.
+
+    One instance guards one ``run_local_shard`` call (all phases), so the
+    per-bucket breaker state persists for the shard's life.  Every
+    participating process must construct it with the same config and bucket
+    set and drive it through the identical round sequence — the verdict
+    allgathers are collectives.
+    """
+
+    def __init__(
+        self,
+        rc=None,
+        buckets: Sequence[int] = (),
+        sleep: Optional[Callable[[float], None]] = None,
+    ) -> None:
+        if rc is None:
+            from ..config.pipeline import ResilienceConfig
+
+            rc = ResilienceConfig()
+        # Jitter MUST be zero: each host computes its own backoff locally,
+        # and the negotiated retry only preserves lockstep if every host
+        # sleeps the same schedule before re-dispatching.
+        overrides = {"jitter": 0.0}
+        if sleep is not None:
+            overrides["sleep"] = sleep
+        self.policy = RetryPolicy.from_config(rc, **overrides)
+        # cooldown_s=0 latches the breaker open: its transitions then depend
+        # only on the (allgathered, therefore identical) verdict sequence,
+        # never on a host-local clock.
+        self.breakers: Dict[int, CircuitBreaker] = {
+            b: CircuitBreaker(
+                rc.breaker_threshold, name=f"negotiated-bucket-{b}",
+                cooldown_s=0.0,
+            )
+            for b in buckets
+        }
+
+    # --- verdict exchange ---------------------------------------------------
+
+    def _negotiate(self, local_fault: bool) -> bool:
+        """Allgather every host's fault flag; True if ANY host faulted.
+
+        Piggybacks on the same :func:`~textblaster_tpu.parallel.multihost.
+        host_allgather` transport the round schedule is negotiated with —
+        one int per host per call (XLA allgather on accelerators, the
+        coordination-service KV store on multi-process CPU)."""
+        from ..parallel.multihost import host_allgather
+
+        flags = host_allgather(np.array([1 if local_fault else 0]))
+        return bool(flags.max() > 0)
+
+    # --- breaker ------------------------------------------------------------
+
+    def bucket_degraded(self, bucket: int) -> bool:
+        """True once ``bucket`` latched open — every host answers the same,
+        because the breaker only moves on negotiated verdicts."""
+        b = self.breakers.get(bucket)
+        return b is not None and b.tripped
+
+    # --- the guarded round --------------------------------------------------
+
+    def run_round(
+        self,
+        bucket: int,
+        dispatch: Callable[[], object],
+        fetch: Callable[[object], Dict[str, np.ndarray]],
+        inflight: Optional[object] = None,
+        launch_fault: bool = False,
+    ):
+        """Resolve one lockstep round under the negotiated protocol.
+
+        ``dispatch`` launches the round's global program (async) and
+        ``fetch`` blocks for this process's host-side stats.  ``inflight``
+        carries an already-dispatched result tree (the one-round overlap in
+        ``run_local_shard``); ``launch_fault`` marks that the overlapped
+        launch already raised a retryable error, so the first attempt goes
+        straight to the verdict.
+
+        Returns the fetched stats, or ``None`` when all hosts jointly
+        degraded the round to the host oracle.  Fatal (deterministic)
+        errors propagate immediately — they would repeat identically on
+        every retry and on every host.
+        """
+        METRICS.inc("resilience_negotiated_rounds_total")
+        attempt = 0
+        while True:
+            local_fault = bool(launch_fault)
+            stats = None
+            if not local_fault:
+                try:
+                    out = inflight if inflight is not None else dispatch()
+                    stats = fetch(out)
+                except BaseException as e:  # noqa: BLE001 — classifier decides
+                    if classify_error(e) != "retryable":
+                        raise
+                    logger.warning(
+                        "Lockstep round (bucket %s) faulted locally on "
+                        "attempt %d: %s",
+                        bucket, attempt + 1, e,
+                    )
+                    local_fault = True
+            # Past the first attempt nothing is in flight: a negotiated
+            # retry must re-dispatch on EVERY host, succeeded ones included.
+            inflight, launch_fault = None, False
+            if not self._negotiate(local_fault):
+                self.breakers[bucket].record_success()
+                return stats
+            if attempt >= self.policy.max_retries:
+                METRICS.inc("resilience_negotiated_degraded_rounds_total")
+                self.breakers[bucket].record_failure(
+                    "negotiated round retries exhausted"
+                )
+                logger.error(
+                    "Lockstep round (bucket %s) exhausted %d negotiated "
+                    "retries; all hosts degrade this round to the host "
+                    "oracle.",
+                    bucket, self.policy.max_retries,
+                )
+                return None
+            delay = self.policy.delay_for(attempt)
+            attempt += 1
+            METRICS.inc("resilience_negotiated_retries_total")
+            logger.warning(
+                "Negotiated retry %d/%d of lockstep round (bucket %s) on "
+                "all hosts, shared backoff %.3fs.",
+                attempt, self.policy.max_retries, bucket, delay,
+            )
+            if delay > 0.0:
+                self.policy.sleep(delay)

@@ -147,6 +147,32 @@ _SPECS: Dict[str, Tuple[str, str]] = {
         "counter",
         "Rows routed to the opt-in dead-letter (--errors-file) sink",
     ),
+    # Negotiated multi-host resilience (resilience/negotiated.py): fault
+    # verdicts are allgathered per lockstep round, so these counters move
+    # identically on every host.
+    "resilience_negotiated_rounds_total": (
+        "counter",
+        "Multi-host lockstep rounds resolved under the negotiated guard",
+    ),
+    "resilience_negotiated_retries_total": (
+        "counter",
+        "Lockstep rounds jointly re-dispatched on every host after a "
+        "negotiated fault verdict",
+    ),
+    "resilience_negotiated_degraded_rounds_total": (
+        "counter",
+        "Lockstep rounds jointly degraded to the host oracle (retry budget "
+        "exhausted or bucket breaker latched)",
+    ),
+    "multihost_merge_commits_total": (
+        "counter",
+        "Final output files committed atomically (tmp+fsync+rename) by the "
+        "host-0 shard merge",
+    ),
+    "multihost_stale_shards_removed_total": (
+        "counter",
+        "Stale *.shard* leftovers from prior runs removed under --force",
+    ),
     # Overlapped-pipeline stage accounting (no reference equivalent).  The
     # counters are wall seconds spent *inside* each stage, summed across
     # worker threads; with overlap on, stages run concurrently, so the sum
