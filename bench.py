@@ -669,6 +669,8 @@ def main() -> int:
 
     from textblaster_tpu.utils.metrics import (
         METRICS,
+        build_run_report,
+        metrics_snapshot,
         occupancy_report,
         occupancy_snapshot,
         stage_breakdown,
@@ -677,6 +679,8 @@ def main() -> int:
 
     stage_before = stage_snapshot()
     occupancy_before = occupancy_snapshot()
+    report_before = metrics_snapshot()
+    report_wall_t0 = time.perf_counter()
     fallbacks_before = METRICS.get("worker_host_fallback_total")
     tails_before = METRICS.get("worker_host_tail_total")
     hazards_before = METRICS.get("worker_fold_hazard_rows_total")
@@ -702,6 +706,23 @@ def main() -> int:
     # Occupancy over exactly the 3 timed passes: how much of the padded
     # codepoint volume the device computed was real document content.
     occ_report = occupancy_report(occupancy_before)
+    # Full run report over the same window (stage/occupancy/resilience/
+    # funnel), embedded in the record so one JSON blob carries the whole
+    # observability surface for the timed passes.
+    from textblaster_tpu.data_model import ProcessingOutcome as _PO
+
+    pass_counts = {
+        "received": 3 * len(run_docs),
+        "success": 3 * sum(1 for o in dev_outcomes if o.kind == _PO.SUCCESS),
+        "filtered": 3 * sum(1 for o in dev_outcomes if o.kind == _PO.FILTERED),
+        "errors": 3 * sum(1 for o in dev_outcomes if o.kind == _PO.ERROR),
+    }
+    run_report = build_run_report(
+        baseline=report_before,
+        wall_time_s=time.perf_counter() - report_wall_t0,
+        counts=pass_counts,
+        provenance={"entry": "bench.py", "passes": 3, "n_docs": len(run_docs)},
+    )
     dev_elapsed = min(device_pass_s)
     dev_rate = len(run_docs) / dev_elapsed
     _log(
@@ -812,6 +833,51 @@ def main() -> int:
             f"{resilience_report['degraded_rounds']} degraded)"
         )
 
+    # --- Tracing overhead, A/B (BENCH_TRACE=0 skips).  The span tracer is
+    # a single attribute check when off; when on it adds two clock reads +
+    # one locked list append per span.  This measures both sides on the
+    # device path so regressions in the "off" fast path (the default for
+    # production runs) or runaway "on" cost (> ~2%) are caught by the bench.
+    trace_report = None
+    if os.environ.get("BENCH_TRACE", "1") != "0":
+        import tempfile
+
+        from textblaster_tpu.utils.trace import TRACER
+
+        trace_tmp = os.path.join(tempfile.gettempdir(), "bench_trace.json")
+        on_pass_s = []
+        trace_events = 0
+        try:
+            for _ in range(2):
+                TRACER.configure(trace_tmp)
+                run = [d.copy() for d in docs]
+                t0 = time.perf_counter()
+                list(
+                    process_documents_device(
+                        config, iter(run), pipeline=pipeline
+                    )
+                )
+                on_pass_s.append(time.perf_counter() - t0)
+                TRACER.close()
+            with open(trace_tmp) as f:
+                trace_events = sum(1 for line in f if '"ph"' in line)
+        finally:
+            TRACER.close()
+            if os.path.exists(trace_tmp):
+                os.remove(trace_tmp)
+        on_rate = len(docs) / min(on_pass_s)
+        trace_report = {
+            "trace_on_docs_per_sec": round(on_rate, 2),
+            "trace_off_docs_per_sec": round(dev_rate, 2),
+            "overhead_frac": round(1.0 - on_rate / dev_rate, 4),
+            "trace_events": int(trace_events),
+        }
+        _log(
+            f"trace: {on_rate:.1f} docs/s on vs {dev_rate:.1f} off "
+            f"(overhead {trace_report['overhead_frac']:+.2%}, "
+            f"{trace_events} events)"
+        )
+
     # Noise self-diagnosis: spreads over the raw passes plus the load
     # averages bracketing each side.  The bench's own process keeps a 1-core
     # box at load ~1; sustained load beyond ~1.8 means a foreign process was
@@ -892,6 +958,12 @@ def main() -> int:
         # Fault-free A/B of the negotiated multi-host fault guard (docs/s
         # with the per-round verdict protocol on vs off) + its counters.
         **({"resilience": resilience_report} if resilience_report else {}),
+        # Trace on/off A/B over the device path: the span tracer must stay
+        # within ~2% of the untraced rate when on and free when off.
+        **({"trace": trace_report} if trace_report else {}),
+        # The merged observability report for the 3 timed passes — same
+        # schema as `--run-report` (stages, occupancy, resilience, funnel).
+        "run_report": run_report,
     }
     if probe_failures:
         result["probe_failures"] = probe_failures

@@ -73,6 +73,39 @@ def test_http_endpoint_serves_metrics():
         server.shutdown()
 
 
+def test_http_endpoint_ignores_query_string_and_serves_head():
+    # Prometheus scrapers append query params (`GET /metrics?timeout=5`) and
+    # probe with HEAD; both must hit the handler, not 404.
+    server = setup_prometheus_metrics(0)
+    assert server is not None
+    try:
+        port = server.server_address[1]
+        METRICS.inc("producer_tasks_published_total")
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics?timeout=5"
+        ) as resp:
+            assert resp.status == 200
+            assert "producer_tasks_published_total" in resp.read().decode()
+        head = urllib.request.Request(
+            f"http://127.0.0.1:{port}/metrics", method="HEAD"
+        )
+        with urllib.request.urlopen(head) as resp:
+            assert resp.status == 200
+            assert int(resp.headers["Content-Length"]) > 0
+            assert resp.read() == b""
+        try:
+            urllib.request.urlopen(
+                urllib.request.Request(
+                    f"http://127.0.0.1:{port}/other", method="HEAD"
+                )
+            )
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        server.shutdown()
+
+
 def test_no_port_means_no_server():
     assert setup_prometheus_metrics(None) is None
 

@@ -1,0 +1,122 @@
+"""Prometheus exposition-format lint for ``Metrics.render()``.
+
+A pure-Python re-statement of the rules promtool's ``check metrics``
+enforces (text format 0.0.4): every sample belongs to a family announced
+by ``# HELP`` + ``# TYPE`` lines that precede it, metric names match the
+legal charset, histogram ``le`` buckets are monotonically non-decreasing
+cumulative counts ending at ``+Inf``, and every histogram carries matching
+``_sum``/``_count`` series.  Run against a registry with every dynamic
+family populated so the generated HELP/TYPE text is linted too.
+"""
+
+import re
+
+from textblaster_tpu.utils.metrics import (
+    FILTER_DROP_PREFIX,
+    OCCUPANCY_BUCKET_PREFIX,
+    Metrics,
+)
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?"
+    r" (?P<value>[^ ]+)$"
+)
+
+
+def _populated_registry() -> Metrics:
+    m = Metrics()
+    # Static families: one counter, one gauge, one histogram spanning
+    # below-first-bucket, mid-range, and overflow observations.
+    m.inc("worker_tasks_processed_total", 7)
+    m.set("worker_active_tasks", 3)
+    for v in (0.001, 0.2, 42.0):
+        m.observe("worker_task_processing_duration_seconds", v)
+    m.observe("producer_task_publishing_duration_seconds", 0.05)
+    # Dynamic families.
+    m.inc(OCCUPANCY_BUCKET_PREFIX + "512", 4)
+    m.inc(OCCUPANCY_BUCKET_PREFIX + "2048", 1)
+    m.inc(FILTER_DROP_PREFIX + "GopherQualityFilter", 9)
+    m.inc(FILTER_DROP_PREFIX + "C4QualityFilter", 2)
+    return m
+
+
+def _base_family(sample_name: str) -> str:
+    # Histogram samples reference their family via the suffixed names.
+    for suffix in ("_bucket", "_sum", "_count"):
+        if sample_name.endswith(suffix):
+            return sample_name[: -len(suffix)]
+    return sample_name
+
+
+def test_exposition_lints_clean():
+    text = _populated_registry().render()
+    assert text.endswith("\n"), "exposition must end with a newline"
+
+    helped: set = set()
+    typed: dict = {}
+    seen_samples: list = []
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(" ", 3)
+            assert len(parts) == 4 and parts[3].strip(), f"bad HELP at {lineno}"
+            assert _NAME_RE.match(parts[2]), f"bad HELP name at {lineno}"
+            assert parts[2] not in helped, f"duplicate HELP {parts[2]}"
+            helped.add(parts[2])
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            assert len(parts) == 4, f"bad TYPE at {lineno}"
+            name, mtype = parts[2], parts[3]
+            assert mtype in ("counter", "gauge", "histogram"), mtype
+            assert name in helped, f"TYPE before HELP for {name}"
+            assert name not in typed, f"duplicate TYPE {name}"
+            typed[name] = mtype
+            continue
+        assert not line.startswith("#"), f"unknown comment at {lineno}: {line}"
+        match = _SAMPLE_RE.match(line)
+        assert match, f"unparseable sample at {lineno}: {line!r}"
+        name = match.group("name")
+        family = _base_family(name)
+        assert family in typed, f"sample {name} has no TYPE"
+        float(match.group("value"))  # must parse
+        seen_samples.append((family, name, match.group("labels"), line))
+
+    # Both dynamic families made it into the exposition with HELP/TYPE.
+    assert OCCUPANCY_BUCKET_PREFIX + "512" in typed
+    assert FILTER_DROP_PREFIX + "GopherQualityFilter" in typed
+
+    # Histogram shape: cumulative monotone le buckets ending at +Inf,
+    # _count equal to the +Inf bucket, _sum present.
+    for family, mtype in typed.items():
+        if mtype != "histogram":
+            continue
+        rows = [s for s in seen_samples if s[0] == family]
+        buckets = [s for s in rows if s[1] == family + "_bucket"]
+        assert buckets, f"histogram {family} has no buckets"
+        les, counts = [], []
+        for _, _, labels, line in buckets:
+            m = re.match(r'^le="([^"]+)"$', labels or "")
+            assert m, f"bucket without le label: {line}"
+            les.append(m.group(1))
+            counts.append(float(line.rsplit(" ", 1)[1]))
+        assert les[-1] == "+Inf", f"{family} buckets must end at +Inf"
+        le_values = [float("inf") if v == "+Inf" else float(v) for v in les]
+        assert le_values == sorted(le_values), f"{family} le not sorted"
+        assert counts == sorted(counts), f"{family} buckets not cumulative"
+        count_rows = [s for s in rows if s[1] == family + "_count"]
+        sum_rows = [s for s in rows if s[1] == family + "_sum"]
+        assert len(count_rows) == 1 and len(sum_rows) == 1
+        assert float(count_rows[0][3].rsplit(" ", 1)[1]) == counts[-1]
+
+
+def test_every_sample_name_is_legal():
+    text = _populated_registry().render()
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name = re.split(r"[{ ]", line, 1)[0]
+        assert _NAME_RE.match(name), f"illegal metric name: {name}"

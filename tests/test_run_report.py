@@ -1,0 +1,192 @@
+"""Run-report construction + the cross-host merged report.
+
+Fast tier: the values-parameterized report builders produce the same
+shapes from a materialized (e.g. allgathered-and-summed) snapshot as from
+the live registry — the property the multihost merge rides on.
+
+Slow tier: a real 2-process coordinated CLI run writes ONE merged report
+on host 0 containing both hosts' snapshots, and its summed totals match
+an equivalent single-host run over the same corpus.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from textblaster_tpu.utils.metrics import (
+    FILTER_DROP_PREFIX,
+    RUN_REPORT_SCHEMA,
+    build_run_report,
+)
+
+REPO = Path(__file__).parent.parent
+
+YAML = """
+pipeline:
+  - type: GopherQualityFilter
+    min_doc_words: 5
+"""
+
+GOOD = (
+    "This is a sentence with a number of words that is long enough to pass "
+    "the filter easily today."
+)
+BAD = "too short"
+
+
+def test_build_run_report_from_summed_values():
+    # Two fake host deltas, summed the way run_multihost does it.
+    host_a = {
+        "stage_read_seconds": 1.0,
+        "stage_device_wait_seconds": 4.0,
+        "occupancy_device_batches_total": 3,
+        "occupancy_padded_lanes_total": 1000,
+        "occupancy_real_codepoints_total": 600,
+        "resilience_retries_total": 2,
+        FILTER_DROP_PREFIX + "GopherQualityFilter": 5,
+    }
+    host_b = {
+        "stage_read_seconds": 2.0,
+        "stage_device_wait_seconds": 1.0,
+        "occupancy_device_batches_total": 2,
+        "occupancy_padded_lanes_total": 500,
+        "occupancy_real_codepoints_total": 400,
+        FILTER_DROP_PREFIX + "GopherQualityFilter": 3,
+        FILTER_DROP_PREFIX + "C4QualityFilter": 1,
+    }
+    summed = dict(host_a)
+    for k, v in host_b.items():
+        summed[k] = summed.get(k, 0) + v
+
+    report = build_run_report(
+        values=summed,
+        wall_time_s=7.5,
+        counts={"received": 20, "filtered": 9},
+        provenance={"entry": "test"},
+        hosts=[{"process": 0}, {"process": 1}],
+    )
+    assert report["schema"] == RUN_REPORT_SCHEMA
+    assert report["num_hosts"] == 2
+    assert report["stages"]["stages_s"]["stage_read_seconds"] == 3.0
+    assert report["stages"]["device_s"] == 5.0
+    assert report["occupancy"]["device_batches"] == 5
+    assert report["occupancy"]["padded_lanes"] == 1500
+    assert report["occupancy"]["waste_ratio"] == round(1 - 1000 / 1500, 4)
+    assert report["resilience"]["resilience_retries_total"] == 2
+    assert report["funnel"]["per_filter_dropped"] == {
+        "GopherQualityFilter": 8,
+        "C4QualityFilter": 1,
+    }
+    assert report["funnel"]["dropped_total"] == 9
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_merged_report_matches_single_host(tmp_path):
+    n = 64
+    inp = tmp_path / "in.parquet"
+    pq.write_table(
+        pa.table(
+            {
+                "id": [f"doc-{i}" for i in range(n)],
+                "text": [GOOD if i % 3 else BAD for i in range(n)],
+            }
+        ),
+        str(inp),
+    )
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(YAML, encoding="utf-8")
+    merged_path = tmp_path / "merged-report.json"
+
+    port = _free_port()
+    procs = []
+    try:
+        for pid in (0, 1):
+            env = {
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+                "PATH": "/usr/bin:/bin:/usr/local/bin",
+                "HOME": "/root",
+            }
+            procs.append(
+                subprocess.Popen(
+                    [
+                        sys.executable, "-m", "textblaster_tpu.cli", "run",
+                        "--coordinator", f"localhost:{port}",
+                        "--num-processes", "2",
+                        "--process-id", str(pid),
+                        "-i", str(inp),
+                        "-o", str(tmp_path / "kept.parquet"),
+                        "-e", str(tmp_path / "excluded.parquet"),
+                        "-c", str(cfg),
+                        "--buckets", "512,2048",
+                        "--quiet",
+                        "--run-report", str(merged_path),
+                    ],
+                    cwd=str(REPO),
+                    env=env,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT,
+                    text=True,
+                )
+            )
+        outputs = [p.communicate(timeout=560)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, o in zip(procs, outputs):
+        assert p.returncode == 0, o
+
+    # Only host 0 writes; the report carries both hosts' snapshots.
+    merged = json.loads(merged_path.read_text(encoding="utf-8"))
+    assert merged["schema"] == RUN_REPORT_SCHEMA
+    assert merged["num_hosts"] == 2
+    assert sorted(h["process"] for h in merged["hosts"]) == [0, 1]
+    for h in merged["hosts"]:
+        assert h["metrics"], "per-host metrics delta is empty"
+        assert h["wall_time_s"] > 0
+
+    # The merged funnel is the sum of the per-host deltas.
+    drop_key = FILTER_DROP_PREFIX + "GopherQualityFilter"
+    per_host_drops = sum(h["metrics"].get(drop_key, 0) for h in merged["hosts"])
+    assert merged["funnel"]["per_filter_dropped"] == {
+        "GopherQualityFilter": per_host_drops
+    }
+
+    # An equivalent single-host run reaches identical summed totals.
+    from textblaster_tpu.cli import main
+
+    single_path = tmp_path / "single-report.json"
+    rc = main(
+        [
+            "run",
+            "-i", str(inp),
+            "-c", str(cfg),
+            "-o", str(tmp_path / "kept-single.parquet"),
+            "-e", str(tmp_path / "excluded-single.parquet"),
+            "--buckets", "512,2048",
+            "--quiet",
+            "--run-report", str(single_path),
+        ]
+    )
+    assert rc == 0
+    single = json.loads(single_path.read_text(encoding="utf-8"))
+    for key in ("received", "success", "filtered", "errors"):
+        assert merged["counts"][key] == single["counts"][key], key
+    assert merged["funnel"] == single["funnel"]
+    excluded_rows = pq.read_table(str(tmp_path / "excluded.parquet")).num_rows
+    assert merged["funnel"]["dropped_total"] == excluded_rows

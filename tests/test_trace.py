@@ -1,0 +1,234 @@
+"""Span tracer correctness (utils/trace.py) + the ``--trace`` /
+``--run-report`` CLI surface.
+
+Four properties, matching the observability acceptance bar:
+
+* Spans recorded on one thread lane nest properly (a ``with`` block cannot
+  partially overlap another on the same thread) and carry sane ts/dur.
+* The span-name multiset is identical between the serial and overlapped
+  host pipelines over the same input — overlap moves *when* stages run,
+  never *what* runs.
+* A chaos run (injected device faults) surfaces the resilience
+  transitions as instant events: policy retries and ladder rungs.
+* An end-to-end CLI run with ``--trace`` produces valid Chrome trace-event
+  JSON containing all six stage spans plus at least one device-dispatch
+  span, and the ``--run-report`` funnel sums exactly to the
+  excluded-Parquet row count.
+"""
+
+import json
+import os
+from collections import Counter
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from textblaster_tpu.cli import main
+from textblaster_tpu.config.pipeline import parse_pipeline_config
+from textblaster_tpu.data_model import TextDocument
+from textblaster_tpu.ops.pipeline import process_documents_device
+from textblaster_tpu.resilience import FAULTS
+from textblaster_tpu.utils.trace import TRACER
+
+CONFIG_YAML = """
+pipeline:
+  - type: GopherQualityFilter
+    min_doc_words: 5
+resilience:
+  backoff_base_s: 0.0
+  backoff_max_s: 0.0
+"""
+
+GOOD = (
+    "This is a sentence with a number of words that is long enough to pass "
+    "the filter easily today."
+)
+BAD = "too short"
+
+#: The six host-pipeline stage span names (ISSUE acceptance set).
+STAGE_SPANS = ("read", "pack", "dispatch", "device_wait", "post", "write")
+
+
+@pytest.fixture(autouse=True)
+def _tracer_hygiene():
+    # TRACER is process-global: a test leaving it enabled (or events in the
+    # ring) would contaminate every later test in the session.
+    TRACER.close()
+    TRACER.drain()
+    yield
+    TRACER.close()
+    TRACER.drain()
+
+
+def _docs(n=30):
+    return [
+        TextDocument(id=f"doc-{i}", content=GOOD if i % 3 else BAD, source="t")
+        for i in range(n)
+    ]
+
+
+def _traced_device_run(config, docs, **kw):
+    TRACER.configure(None)  # in-memory ring
+    list(process_documents_device(config, iter(docs), **kw))
+    TRACER.close()
+    return TRACER.drain()
+
+
+def test_spans_nest_within_each_lane():
+    config = parse_pipeline_config(CONFIG_YAML)
+    events = _traced_device_run(config, _docs(30), device_batch=16)
+    spans = [e for e in events if e.get("ph") == "X"]
+    assert spans, "traced run produced no spans"
+    by_tid = {}
+    for e in spans:
+        assert e["dur"] >= 0 and e["ts"] >= 0
+        by_tid.setdefault(e["tid"], []).append(e)
+    for lane in by_tid.values():
+        # Within a lane, sorted by start (longer span first on ties), every
+        # span must either nest inside the enclosing open span or start
+        # after it ends — partial overlap means broken emission.
+        lane.sort(key=lambda e: (e["ts"], -e["dur"]))
+        stack = []
+        for e in lane:
+            while stack and e["ts"] >= stack[-1]["ts"] + stack[-1]["dur"]:
+                stack.pop()
+            if stack:
+                enclosing = stack[-1]
+                assert (
+                    e["ts"] + e["dur"] <= enclosing["ts"] + enclosing["dur"]
+                ), f"span {e['name']} partially overlaps {enclosing['name']}"
+            stack.append(e)
+
+
+def test_serial_and_overlapped_runs_emit_same_span_multiset(
+    tmp_path, monkeypatch
+):
+    from textblaster_tpu.parallel.runner import run_pipeline
+
+    docs = _docs(60)
+    inp = tmp_path / "in.parquet"
+    pq.write_table(
+        pa.table(
+            {
+                "id": [d.id for d in docs],
+                "text": [d.content for d in docs],
+                "source": [d.source for d in docs],
+            }
+        ),
+        str(inp),
+    )
+    config = parse_pipeline_config(CONFIG_YAML)
+
+    def _run(tag, no_overlap):
+        if no_overlap:
+            monkeypatch.setenv("TEXTBLAST_NO_OVERLAP", "1")
+        else:
+            monkeypatch.delenv("TEXTBLAST_NO_OVERLAP", raising=False)
+        TRACER.configure(None)
+        run_pipeline(
+            config,
+            str(inp),
+            str(tmp_path / f"out-{tag}.parquet"),
+            str(tmp_path / f"exc-{tag}.parquet"),
+            backend="tpu",
+            device_batch=16,
+            quiet=True,
+        )
+        TRACER.close()
+        return Counter(
+            e["name"] for e in TRACER.drain() if e.get("ph") == "X"
+        )
+
+    serial = _run("serial", no_overlap=True)
+    overlapped = _run("overlap", no_overlap=False)
+    assert serial == overlapped
+    for name in STAGE_SPANS:
+        assert serial[name] > 0, f"stage span {name} missing"
+
+
+def test_chaos_run_emits_resilience_instants():
+    config = parse_pipeline_config(CONFIG_YAML)
+    # Transient blip: recovered by a policy retry -> a "retry" instant.
+    FAULTS.inject("device.execute", OSError("device blip"), times=2)
+    events = _traced_device_run(config, _docs(10), device_batch=16)
+    instants = Counter(e["name"] for e in events if e.get("ph") == "i")
+    assert instants["retry"] >= 1
+    FAULTS.reset()
+
+    # Budget exhaustion: the ladder splits the batch -> a "ladder_split"
+    # instant (times=5 = dispatch + the 1+3 policy attempts, per
+    # tests/test_fault_injection.py accounting).
+    FAULTS.inject("device.execute", OSError("persistent-ish"), times=5)
+    events = _traced_device_run(config, _docs(10), device_batch=16)
+    instants = Counter(e["name"] for e in events if e.get("ph") == "i")
+    assert instants["ladder_split"] >= 1
+
+
+def test_cli_trace_and_run_report_end_to_end(tmp_path, capsys):
+    docs = _docs(120)
+    inp = tmp_path / "in.parquet"
+    pq.write_table(
+        pa.table(
+            {
+                "id": [d.id for d in docs],
+                "text": [d.content for d in docs],
+            }
+        ),
+        str(inp),
+    )
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(CONFIG_YAML, encoding="utf-8")
+    out = tmp_path / "out.parquet"
+    exc = tmp_path / "exc.parquet"
+    trace_path = tmp_path / "trace.json"
+    report_path = tmp_path / "report.json"
+
+    rc = main(
+        [
+            "run",
+            "-i", str(inp),
+            "-c", str(cfg),
+            "-o", str(out),
+            "-e", str(exc),
+            "--backend", "tpu",
+            "--buckets", "512,2048",
+            "--quiet",
+            "--trace", str(trace_path),
+            "--run-report", str(report_path),
+        ]
+    )
+    assert rc == 0
+    capsys.readouterr()
+
+    # The trace is well-formed Chrome trace-event JSON (array flavor) with
+    # every stage span and at least one device dispatch.
+    events = json.loads(trace_path.read_text(encoding="utf-8"))
+    assert isinstance(events, list) and events
+    names = Counter(e["name"] for e in events if e.get("ph") == "X")
+    for stage in STAGE_SPANS:
+        assert names[stage] > 0, f"stage span {stage} missing from trace"
+    assert names["device_dispatch"] >= 1
+    assert any(
+        e.get("ph") == "M" and e["name"] == "process_name" for e in events
+    )
+
+    # The run report's funnel sums exactly to the excluded row count.
+    report = json.loads(report_path.read_text(encoding="utf-8"))
+    assert report["schema"] == "textblaster-run-report/v1"
+    excluded_rows = pq.read_table(str(exc)).num_rows
+    assert report["funnel"]["dropped_total"] == excluded_rows
+    assert (
+        sum(report["funnel"]["per_filter_dropped"].values()) == excluded_rows
+    )
+    assert report["funnel"]["per_filter_dropped"] == {
+        "GopherQualityFilter": excluded_rows
+    }
+    assert report["counts"]["filtered"] == excluded_rows
+    assert report["counts"]["success"] == pq.read_table(str(out)).num_rows
+    assert report["stages"]["verdict"] in (
+        "host-bound", "device-bound", "balanced"
+    )
+    assert report["occupancy"]["device_batches"] >= 1
+    assert report["config"]["backend"] == "tpu"
+    assert os.path.getsize(trace_path) > 0

@@ -22,7 +22,17 @@ from . import __version__
 from .config.pipeline import load_pipeline_config
 from .errors import PipelineError
 from .utils.logging_setup import init_logging
-from .utils.metrics import METRICS, setup_prometheus_metrics
+from .utils.metrics import (
+    METRICS,
+    build_run_report,
+    format_funnel_summary,
+    funnel_report,
+    funnel_snapshot,
+    metrics_snapshot,
+    setup_prometheus_metrics,
+    write_run_report,
+)
+from .utils.trace import TRACER, device_profile
 
 __all__ = ["main", "build_parser"]
 
@@ -92,7 +102,32 @@ def build_parser() -> argparse.ArgumentParser:
                           "byte-identical either way; this is the "
                           "escape hatch and A/B baseline")
     run.add_argument("--metrics-port", type=int, default=None,
-                     help="Port for the Prometheus metrics HTTP endpoint")
+                     help="Port for the Prometheus metrics HTTP endpoint "
+                          "(with --coordinator the port is offset by "
+                          "--process-id so co-located processes don't "
+                          "collide on the bind)")
+    run.add_argument("--trace", default=None, metavar="OUT.JSON",
+                     help="Record a Chrome trace-event JSON of the run "
+                          "(per-batch spans for every pipeline stage across "
+                          "the overlap threads, per-round spans on the "
+                          "multihost path, instant events for resilience "
+                          "transitions).  Load it at https://ui.perfetto.dev "
+                          "or chrome://tracing.  Near-zero cost when off; "
+                          "with --coordinator, process i>0 writes "
+                          "OUT.JSON.host<i>")
+    run.add_argument("--trace-device", default=None, metavar="LOGDIR",
+                     help="Also capture the XLA device-side profile via "
+                          "jax.profiler.trace into LOGDIR (TensorBoard/"
+                          "Perfetto-loadable).  Opt-in and independent of "
+                          "--trace")
+    run.add_argument("--run-report", default=None, metavar="REPORT.JSON",
+                     help="Write a machine-readable end-of-run report "
+                          "(stage breakdown, occupancy, resilience "
+                          "counters, per-filter drop funnel, wall time, "
+                          "config provenance).  With --coordinator, pass it "
+                          "on every process; process 0 writes one merged "
+                          "report with per-host snapshots and summed "
+                          "totals")
     run.add_argument("--quiet", action="store_true", help="Suppress progress output")
     run.add_argument("--checkpoint-dir", default=None,
                      help="Enable chunk-level checkpointing in this directory; "
@@ -141,7 +176,12 @@ def _cmd_validate(args: argparse.Namespace) -> int:
 
 def _cmd_run(args: argparse.Namespace) -> int:
     init_logging("textblast")
-    setup_prometheus_metrics(args.metrics_port)
+    metrics_port = args.metrics_port
+    if metrics_port is not None and args.coordinator:
+        # Co-located processes (multi-process CPU, one host) would collide
+        # on the bind; rank-offset ports keep every /metrics reachable.
+        metrics_port += args.process_id
+    setup_prometheus_metrics(metrics_port)
 
     if args.backend == "cpu":
         # Compiled pipeline pinned to the in-process CPU backend; drops any
@@ -196,6 +236,34 @@ def _cmd_run(args: argparse.Namespace) -> int:
               "on --backend host", file=sys.stderr)
         return 1
 
+    if args.trace:
+        trace_path = args.trace
+        if args.coordinator and args.process_id:
+            trace_path = f"{args.trace}.host{args.process_id}"
+        TRACER.configure(
+            trace_path,
+            process_name=f"textblast-host{args.process_id}"
+            if args.coordinator else "textblast",
+            pid=args.process_id,
+        )
+
+    provenance = {
+        "entry": "textblast run",
+        "version": __version__,
+        "pipeline_config": args.pipeline_config,
+        "steps": [s.type for s in config.pipeline],
+        "input_file": args.input_file,
+        "backend": args.backend,
+        "buckets": list(buckets) if buckets else None,
+        "device_batch": args.device_batch,
+        "auto_geometry": bool(args.auto_geometry),
+        "overlap_enabled": bool(config.overlap.enabled),
+        "pipeline_depth": int(config.overlap.pipeline_depth),
+        "num_processes": args.num_processes,
+    }
+    report_baseline = metrics_snapshot() if args.run_report else None
+    funnel_before = funnel_snapshot()
+
     start = time.perf_counter()
     fallbacks_before = METRICS.get("worker_host_fallback_total")
 
@@ -208,6 +276,11 @@ def _cmd_run(args: argparse.Namespace) -> int:
         print("--coordinator requires the compiled pipeline "
               "(--backend tpu or cpu, not host)", file=sys.stderr)
         return 1
+    # Entered manually (not a with-block) so the existing dispatch block
+    # keeps its indentation; TRACER.close() must run on every path so a
+    # failed run still leaves a loadable (truncation-tolerant) trace.
+    profile_ctx = device_profile(args.trace_device)
+    profile_ctx.__enter__()
     try:
         if args.coordinator:
             from .parallel.multihost import run_multihost
@@ -232,6 +305,8 @@ def _cmd_run(args: argparse.Namespace) -> int:
                 read_batch_size=args.batch_size,
                 errors_file=args.errors_file,
                 force=args.force,
+                run_report=args.run_report,
+                provenance=provenance,
                 **mh_kwargs,
             )
         elif args.checkpoint_dir:
@@ -278,6 +353,9 @@ def _cmd_run(args: argparse.Namespace) -> int:
     except PipelineError as e:
         print(f"Pipeline run failed: {e}", file=sys.stderr)
         return 1
+    finally:
+        profile_ctx.__exit__(None, None, None)
+        TRACER.close()
 
     elapsed = time.perf_counter() - start
     total = result.received
@@ -349,6 +427,35 @@ def _cmd_run(args: argparse.Namespace) -> int:
             print(format_stage_summary(), file=sys.stderr)
         if METRICS.get("occupancy_device_batches_total") > 0:
             print(format_occupancy_summary(), file=sys.stderr)
+        if funnel_report(funnel_before)["dropped_total"] > 0:
+            print(
+                format_funnel_summary(
+                    funnel_before, order=[s.type for s in config.pipeline]
+                ),
+                file=sys.stderr,
+            )
+        if args.trace:
+            print(f"Trace written -> {args.trace} "
+                  "(load at https://ui.perfetto.dev)", file=sys.stderr)
+
+    if args.run_report and not args.coordinator:
+        # Coordinator runs write the merged report from run_multihost
+        # (process 0, after the snapshot allgather) instead.
+        report = build_run_report(
+            baseline=report_baseline,
+            wall_time_s=elapsed,
+            counts={
+                "received": result.received,
+                "success": result.success,
+                "filtered": result.filtered,
+                "errors": result.errors,
+                "read_errors": result.read_errors,
+            },
+            provenance=provenance,
+        )
+        write_run_report(args.run_report, report)
+        if not args.quiet:
+            print(f"Run report -> {args.run_report}", file=sys.stderr)
     return 0
 
 

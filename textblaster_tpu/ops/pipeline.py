@@ -50,7 +50,8 @@ from ..pipeline_builder import build_pipeline_from_config
 from ..resilience.breaker import CircuitBreaker
 from ..resilience.faults import FAULTS
 from ..resilience.retry import RetryPolicy
-from ..utils.metrics import METRICS
+from ..utils.metrics import FILTER_DROP_PREFIX, METRICS
+from ..utils.trace import TRACER
 from ..utils.overlap import prefetch_iter
 from .badwords import badwords_matches_multi
 from .langid_tpu import langid_scores
@@ -1229,24 +1230,31 @@ class CompiledPipeline:
         (the double-buffered feed SURVEY.md §2.5 maps prefetch/QoS onto)."""
         FAULTS.fire("device.execute")
         record_occupancy(batch)
-        fn = self._fn_for(batch.max_len, phase, rows=batch.batch_size)
-        if self.mesh is not None:
-            from ..parallel.mesh import shard_batch
+        with TRACER.span(
+            "device_dispatch",
+            {"bucket": batch.max_len, "rows": batch.batch_size,
+             "phase": phase},
+        ):
+            fn = self._fn_for(batch.max_len, phase, rows=batch.batch_size)
+            if self.mesh is not None:
+                from ..parallel.mesh import shard_batch
 
-            cps, lengths = shard_batch(self.mesh, batch.cps, batch.lengths)
-        else:
-            cps, lengths = batch.cps, batch.lengths
-            if self.wire_u16:
-                # Astral rows were routed to the host oracle upstream
-                # (process_chunk); a slip here would truncate silently, so
-                # guard with one cheap vectorized check.
-                if int(cps.max(initial=0)) >= 0x10000:
-                    raise RuntimeError(
-                        "astral codepoint reached the uint16 wire — "
-                        "routing invariant broken"
-                    )
-                cps = cps.astype(np.uint16)
-        return fn(cps, lengths)
+                cps, lengths = shard_batch(
+                    self.mesh, batch.cps, batch.lengths
+                )
+            else:
+                cps, lengths = batch.cps, batch.lengths
+                if self.wire_u16:
+                    # Astral rows were routed to the host oracle upstream
+                    # (process_chunk); a slip here would truncate silently,
+                    # so guard with one cheap vectorized check.
+                    if int(cps.max(initial=0)) >= 0x10000:
+                        raise RuntimeError(
+                            "astral codepoint reached the uint16 wire — "
+                            "routing invariant broken"
+                        )
+                    cps = cps.astype(np.uint16)
+            return fn(cps, lengths)
 
     def dispatch_lockstep(
         self, batch: PackedBatch, phase: int, sharding2, sharding1
@@ -1262,10 +1270,19 @@ class CompiledPipeline:
         the caller records it once per round so negotiated re-dispatches don't
         skew the telemetry."""
         FAULTS.fire("multihost.round")
-        fn = self._fn_for(batch.max_len, phase)
-        g_cps = jax.make_array_from_process_local_data(sharding2, batch.cps)
-        g_len = jax.make_array_from_process_local_data(sharding1, batch.lengths)
-        return fn(g_cps, g_len)
+        with TRACER.span(
+            "device_dispatch",
+            {"bucket": batch.max_len, "rows": batch.batch_size,
+             "phase": phase, "lockstep": True},
+        ):
+            fn = self._fn_for(batch.max_len, phase)
+            g_cps = jax.make_array_from_process_local_data(
+                sharding2, batch.cps
+            )
+            g_len = jax.make_array_from_process_local_data(
+                sharding1, batch.lengths
+            )
+            return fn(g_cps, g_len)
 
     # --- degradation ladder -------------------------------------------------
 
@@ -1290,7 +1307,11 @@ class CompiledPipeline:
                 stats = self.dispatch_batch(batch, phase)
             t0 = time.perf_counter()
             try:
-                return jax.device_get(stats)
+                with TRACER.span(
+                    "device_wait",
+                    {"bucket": batch.max_len, "phase": phase},
+                ):
+                    return jax.device_get(stats)
             finally:
                 # Time blocked on device results (transfer + any compute not
                 # yet finished).  Identity-fast for already-numpy stats, so
@@ -1351,6 +1372,9 @@ class CompiledPipeline:
             # they share one traced program shape (a fresh jit entry — the
             # warmup's AOT executables are fixed to the full batch size).
             METRICS.inc("resilience_ladder_split_total")
+            TRACER.instant(
+                "ladder_split", {"bucket": batch.max_len, "phase": phase}
+            )
             sub_rows = (batch.batch_size + 1) // 2
             mid = (len(batch.docs) + 1) // 2
             for part in (batch.docs[:mid], batch.docs[mid:]):
@@ -1371,6 +1395,9 @@ class CompiledPipeline:
             outcomes.extend(self._host_rerun(batch.docs))
 
         if fell_to_host:
+            TRACER.instant(
+                "ladder_host", {"bucket": batch.max_len, "phase": phase}
+            )
             self._breaker.record_failure("device batch fell to host rung")
         else:
             self._breaker.record_success()
@@ -1491,7 +1518,12 @@ class CompiledPipeline:
         from the module-scope import, not a per-call ``import time``."""
         t0 = _time_mod.perf_counter()
         try:
-            return pack_documents(docs, batch_size=batch_size, max_len=max_len)
+            with TRACER.span(
+                "pack", {"rows": len(docs), "bucket": max_len}
+            ):
+                return pack_documents(
+                    docs, batch_size=batch_size, max_len=max_len
+                )
         finally:
             METRICS.inc("stage_pack_seconds", _time_mod.perf_counter() - t0)
 
@@ -1651,14 +1683,16 @@ class CompiledPipeline:
                 nonlocal inflight
                 kind, payload = window.popleft()
                 ta = time.perf_counter()
-                if kind == "batch":
-                    inflight -= 1
-                    METRICS.set("inflight_batches", inflight)
-                    b, stats = payload
-                    outcomes, alive = self._execute_packed(b, phase, stats)
-                    survivors.extend(alive)
-                else:
-                    outcomes = _process_fallback(payload)
+                with TRACER.span("post", {"kind": kind, "phase": phase}):
+                    if kind == "batch":
+                        inflight -= 1
+                        METRICS.set("inflight_batches", inflight)
+                        TRACER.counter("inflight_batches", inflight)
+                        b, stats = payload
+                        outcomes, alive = self._execute_packed(b, phase, stats)
+                        survivors.extend(alive)
+                    else:
+                        outcomes = _process_fallback(payload)
                 dt = time.perf_counter() - ta
                 timing["drain"] += dt
                 METRICS.inc("stage_post_seconds", dt)
@@ -1679,15 +1713,24 @@ class CompiledPipeline:
                         batch = item.result() if hasattr(item, "result") else item
                         if overlapped:
                             METRICS.set("queue_depth_pack", src.qsize())
+                            TRACER.counter("queue_depth_pack", src.qsize())
                         n_batches += 1
                         td = time.perf_counter()
-                        stats = self._dispatch_window(batch, phase, no_overlap)
+                        with TRACER.span(
+                            "dispatch",
+                            {"bucket": batch.max_len,
+                             "rows": batch.batch_size, "phase": phase},
+                        ):
+                            stats = self._dispatch_window(
+                                batch, phase, no_overlap
+                            )
                         dt = time.perf_counter() - td
                         timing["dispatch"] += dt
                         METRICS.inc("stage_dispatch_seconds", dt)
                         window.append(("batch", (batch, stats)))
                         inflight += 1
                         METRICS.set("inflight_batches", inflight)
+                        TRACER.counter("inflight_batches", inflight)
                     if fallback:
                         window.append(("host", fallback))
                     # Host groups at the front never block on the device —
@@ -1755,6 +1798,10 @@ class CompiledPipeline:
                 if decision.extra.get("rewrite"):
                     self._rewrite_c4(doc, step, decision.extra["keep_mask"])
             if not decision.passed:
+                # Funnel attribution: the device-path twin of the host seam
+                # in orchestration.execute_processing_pipeline — together
+                # the only two creators of FILTERED outcomes.
+                METRICS.inc(FILTER_DROP_PREFIX + step.type)
                 return ProcessingOutcome.filtered(doc, decision.reason)
         return None
 

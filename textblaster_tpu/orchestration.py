@@ -25,7 +25,7 @@ from .data_model import ProcessingOutcome, TextDocument
 from .errors import PipelineError, StepError
 from .executor import PipelineExecutor
 from .io import ParquetInputConfig, ParquetReader, ParquetWriter
-from .utils.metrics import METRICS
+from .utils.metrics import FILTER_DROP_PREFIX, METRICS
 
 logger = logging.getLogger(__name__)
 
@@ -100,6 +100,11 @@ def execute_processing_pipeline(
         filtered = e.filtered()
         if filtered is not None:
             METRICS.inc("worker_tasks_filtered_total")
+            # Funnel attribution: this is one of exactly two seams that
+            # create a FILTERED outcome (the other is _assemble_row on the
+            # device path), so the per-filter counters sum to the
+            # excluded-Parquet row count by construction.
+            METRICS.inc(FILTER_DROP_PREFIX + e.step_name)
             return ProcessingOutcome.filtered(filtered.document, filtered.reason)
         METRICS.inc("worker_tasks_failed_total")
         logger.error("Hard error in step '%s': %s", e.step_name, e.source)

@@ -40,6 +40,7 @@ after every rename lands.
 from __future__ import annotations
 
 import itertools
+import json
 import math
 from typing import Iterable, List, Optional, Sequence, Tuple
 
@@ -49,6 +50,7 @@ import numpy as np
 from ..config.pipeline import PipelineConfig
 from ..data_model import ProcessingOutcome, TextDocument
 from ..ops.packing import pack_documents
+from ..utils.trace import TRACER
 from .mesh import DATA_AXIS, batch_sharding
 
 __all__ = [
@@ -240,6 +242,32 @@ def host_allgather(vec: np.ndarray) -> np.ndarray:
     return np.asarray(rows, dtype=np.int64)
 
 
+def host_allgather_obj(obj) -> list:
+    """Allgather one small JSON-serializable object per process.
+
+    Rides :func:`host_allgather` (the only transport this module trusts):
+    the object is JSON-encoded to UTF-8 bytes, lengths are exchanged first
+    so every process can pad its byte vector to the common width, then the
+    padded vectors are exchanged and each row decoded back.  Two collectives
+    per call — callers must invoke it in lockstep, like every other
+    exchange here.  Sized for metrics snapshots (a few KiB), not bulk data:
+    each byte travels as an int64 lane."""
+    data = json.dumps(obj, sort_keys=True).encode("utf-8")
+    n = jax.process_count()
+    lens = host_allgather(np.array([len(data)]))[:, 0]
+    width = max(1, int(lens.max()))
+    buf = np.zeros(width, dtype=np.int64)
+    if data:
+        buf[: len(data)] = np.frombuffer(data, dtype=np.uint8)
+    rows = host_allgather(buf)
+    return [
+        json.loads(
+            bytes(rows[i, : int(lens[i])].astype(np.uint8)).decode("utf-8")
+        )
+        for i in range(n)
+    ]
+
+
 def _local_stats(out: dict) -> dict:
     """This process's rows of every ``data``-sharded output, in row order,
     moved in ONE bundled transfer (per-key np.asarray is a synchronous round
@@ -389,25 +417,30 @@ def run_local_shard(
         """Block for one in-flight round and assemble it — under the
         negotiated verdict protocol when the guard is on."""
         local, ph = entry["batch"], entry["phase"]
-        if guard is None:
-            stats = _local_stats(entry["out"])
-        else:
-            b = entry["bucket"]
-            stats = guard.run_round(
-                b,
-                dispatch=lambda: pipeline.dispatch_lockstep(local, ph, sh2, sh1),
-                fetch=_local_stats,
-                inflight=entry["out"],
-                launch_fault=entry["fault"],
-            )
-            if stats is None:
-                # Jointly degraded: every host routes this round's chunk to
-                # the host oracle; none re-enters the program.
-                degraded.extend(local.docs)
-                return
-        po, alive = pipeline.assemble_phase(local, stats, ph)
-        outcomes.extend(po)
-        survivors.extend(alive)
+        with TRACER.span(
+            "lockstep_resolve", {"bucket": entry["bucket"], "phase": ph}
+        ):
+            if guard is None:
+                stats = _local_stats(entry["out"])
+            else:
+                b = entry["bucket"]
+                stats = guard.run_round(
+                    b,
+                    dispatch=lambda: pipeline.dispatch_lockstep(
+                        local, ph, sh2, sh1
+                    ),
+                    fetch=_local_stats,
+                    inflight=entry["out"],
+                    launch_fault=entry["fault"],
+                )
+                if stats is None:
+                    # Jointly degraded: every host routes this round's chunk
+                    # to the host oracle; none re-enters the program.
+                    degraded.extend(local.docs)
+                    return
+            po, alive = pipeline.assemble_phase(local, stats, ph)
+            outcomes.extend(po)
+            survivors.extend(alive)
 
     outcomes: List[ProcessingOutcome] = []
     n_phases = len(pipeline.phases)
@@ -435,11 +468,22 @@ def run_local_shard(
                     # dispatch is skipped jointly — lockstep preserved
                     # without touching the device.
                     METRICS.inc("resilience_negotiated_degraded_rounds_total")
+                    TRACER.instant(
+                        "negotiated_bucket_latched",
+                        {"bucket": b, "round": r, "phase": phase},
+                    )
                     degraded.extend(chunk)
                     continue
-                local = pack_documents(chunk, batch_size=local_batch, max_len=b)
-                record_occupancy(local)
-                out, fault = launch(local, phase)
+                with TRACER.span(
+                    "lockstep_round",
+                    {"bucket": b, "round": r, "phase": phase,
+                     "rows": len(chunk)},
+                ):
+                    local = pack_documents(
+                        chunk, batch_size=local_batch, max_len=b
+                    )
+                    record_occupancy(local)
+                    out, fault = launch(local, phase)
                 if pending is not None:
                     resolve(pending, outcomes, survivors)
                 pending = {
@@ -486,8 +530,17 @@ def run_multihost(
     auto_geometry: bool = False,
     errors_file: Optional[str] = None,
     force: bool = False,
+    run_report: Optional[str] = None,
+    provenance: Optional[dict] = None,
 ):
     """Production multi-host entry (``textblast run --coordinator ...``).
+
+    ``run_report`` (must be passed on EVERY process or on none — the
+    snapshot exchange is a collective) makes each process contribute its
+    metrics-delta snapshot over :func:`host_allgather_obj` after the totals
+    barrier; process 0 writes a merged run report to that path with both
+    the per-host snapshots and the summed totals.  ``provenance`` is the
+    config-provenance dict embedded in the report.
 
     Each process reads its contiguous row stripe of ``input_file`` (the
     static shard assignment SURVEY.md §2.5 maps the task queue onto), runs
@@ -531,7 +584,12 @@ def run_multihost(
     )
     from ..resilience import DeadLetterSink
     from ..resilience.faults import arm_from_env
-    from ..utils.metrics import METRICS
+    from ..utils.metrics import (
+        METRICS,
+        build_run_report,
+        metrics_snapshot,
+        write_run_report,
+    )
 
     finals = [output_file, excluded_file]
     if errors_file is not None:
@@ -569,6 +627,13 @@ def run_multihost(
         )
     arm_from_env(process_id=process_id)
     mesh = global_data_mesh()
+
+    import time as _time
+
+    # Run-report scope starts here: everything after distributed init is
+    # this run's work, so the snapshot deltas attribute only it.
+    values_before = metrics_snapshot() if run_report is not None else {}
+    wall_t0 = _time.perf_counter()
 
     n_rows = pq.ParquetFile(input_file).metadata.num_rows
     stride = math.ceil(n_rows / max(num_processes, 1))
@@ -655,6 +720,33 @@ def run_multihost(
     # blocking gets release only once every peer has posted).
     all_totals = host_allgather(totals).reshape(-1, 5)
 
+    # Cross-host metrics aggregation: one more lockstep exchange carrying
+    # each process's metrics-delta snapshot (a few KiB of JSON), so host
+    # 0's report survives the other processes' exit.  Runs on EVERY
+    # process or on none — see the docstring contract.
+    host_reports = None
+    if run_report is not None:
+        now = metrics_snapshot()
+        local_delta = {
+            k: round(now.get(k, 0.0) - values_before.get(k, 0.0), 6)
+            for k in set(now) | set(values_before)
+            if now.get(k, 0.0) != values_before.get(k, 0.0)
+        }
+        host_reports = host_allgather_obj(
+            {
+                "process": process_id,
+                "wall_time_s": round(_time.perf_counter() - wall_t0, 3),
+                "counts": {
+                    "received": result.received,
+                    "success": result.success,
+                    "filtered": result.filtered,
+                    "errors": result.errors,
+                    "read_errors": result.read_errors,
+                },
+                "metrics": local_delta,
+            }
+        )
+
     if process_id == 0:
         merge_shard_files(
             [
@@ -666,6 +758,25 @@ def run_multihost(
         merged = AggregationResult()
         merged.received, merged.success, merged.filtered = int(g[0]), int(g[1]), int(g[2])
         merged.errors, merged.read_errors = int(g[3]), int(g[4])
+        if host_reports is not None:
+            summed: dict = {}
+            for h in host_reports:
+                for k, v in h["metrics"].items():
+                    summed[k] = summed.get(k, 0.0) + v
+            report = build_run_report(
+                values=summed,
+                wall_time_s=max(h["wall_time_s"] for h in host_reports),
+                counts={
+                    "received": merged.received,
+                    "success": merged.success,
+                    "filtered": merged.filtered,
+                    "errors": merged.errors,
+                    "read_errors": merged.read_errors,
+                },
+                provenance=provenance,
+                hosts=host_reports,
+            )
+            write_run_report(run_report, report)
         return merged
     return result
 
@@ -676,6 +787,7 @@ def _main(argv: Optional[Sequence[str]] = None) -> int:
     import argparse
 
     from ..config.pipeline import load_pipeline_config
+    from ..utils.metrics import setup_prometheus_metrics
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--coordinator", required=True)
@@ -693,26 +805,67 @@ def _main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--device-batch", type=int, default=None)
     ap.add_argument("--auto-geometry", action="store_true")
     ap.add_argument("--force", action="store_true")
+    ap.add_argument(
+        "--metrics-port", type=int, default=None,
+        help="serve /metrics on this port + process-id (the offset keeps "
+        "co-located processes from colliding on the bind)",
+    )
+    ap.add_argument(
+        "--trace", default=None, metavar="OUT.JSON",
+        help="record a Chrome trace (process 0 writes OUT.JSON, process i "
+        "writes OUT.JSON.host<i>)",
+    )
+    ap.add_argument(
+        "--run-report", default=None, metavar="REPORT.JSON",
+        help="process 0 writes a merged machine-readable run report "
+        "(pass on every process — the snapshot exchange is a collective)",
+    )
     args = ap.parse_args(argv)
 
+    if args.metrics_port is not None:
+        setup_prometheus_metrics(args.metrics_port + args.process_id)
+    if args.trace:
+        trace_path = (
+            args.trace if args.process_id == 0
+            else f"{args.trace}.host{args.process_id}"
+        )
+        TRACER.configure(
+            trace_path,
+            process_name=f"textblast-host{args.process_id}",
+            pid=args.process_id,
+        )
+
     config = load_pipeline_config(args.pipeline_config)
-    result = run_multihost(
-        config,
-        args.input_file,
-        args.output_file,
-        args.excluded_file,
-        coordinator=args.coordinator,
-        num_processes=args.num_processes,
-        process_id=args.process_id,
-        text_column=args.text_column,
-        id_column=args.id_column,
-        read_batch_size=args.read_batch_size,
-        buckets=tuple(int(b) for b in args.buckets.split(",")),
-        device_batch=args.device_batch,
-        auto_geometry=args.auto_geometry,
-        errors_file=args.errors_file,
-        force=args.force,
-    )
+    try:
+        result = run_multihost(
+            config,
+            args.input_file,
+            args.output_file,
+            args.excluded_file,
+            coordinator=args.coordinator,
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+            text_column=args.text_column,
+            id_column=args.id_column,
+            read_batch_size=args.read_batch_size,
+            buckets=tuple(int(b) for b in args.buckets.split(",")),
+            device_batch=args.device_batch,
+            auto_geometry=args.auto_geometry,
+            errors_file=args.errors_file,
+            force=args.force,
+            run_report=args.run_report,
+            provenance={
+                "entry": "textblaster_tpu.parallel.multihost",
+                "pipeline_config": args.pipeline_config,
+                "steps": [s.type for s in config.pipeline],
+                "input_file": args.input_file,
+                "num_processes": args.num_processes,
+                "buckets": args.buckets,
+                "auto_geometry": args.auto_geometry,
+            },
+        )
+    finally:
+        TRACER.close()
     print(
         f"process {args.process_id}: {result.received} outcomes "
         f"({result.success} kept, {result.filtered} excluded)"

@@ -35,6 +35,7 @@ from ..orchestration import (
 from ..pipeline_builder import build_pipeline_from_config
 from ..resilience.deadletter import DeadLetterSink
 from ..resilience.retry import RetryPolicy
+from ..utils.trace import TRACER
 
 logger = logging.getLogger(__name__)
 
@@ -151,24 +152,25 @@ def run_pipeline(
                 from ..errors import PipelineError as _PipelineError
                 from ..ops.geometry import CALIBRATION_SAMPLE, calibrate_geometry
 
-                it = iter(docs)
-                head = list(islice(it, CALIBRATION_SAMPLE))
-                lengths = [
-                    len(d.content)
-                    for d in head
-                    if not isinstance(d, _PipelineError)
-                ]
-                if lengths:
-                    geometry = calibrate_geometry(
-                        lengths, backend=jax.default_backend()
-                    )
-                    logger.info(
-                        "Auto-calibrated device geometry from %d sampled "
-                        "documents: %s",
-                        len(lengths),
-                        geometry.describe(),
-                    )
-                docs = chain(head, it)
+                with TRACER.span("calibration"):
+                    it = iter(docs)
+                    head = list(islice(it, CALIBRATION_SAMPLE))
+                    lengths = [
+                        len(d.content)
+                        for d in head
+                        if not isinstance(d, _PipelineError)
+                    ]
+                    if lengths:
+                        geometry = calibrate_geometry(
+                            lengths, backend=jax.default_backend()
+                        )
+                        logger.info(
+                            "Auto-calibrated device geometry from %d sampled "
+                            "documents: %s",
+                            len(lengths),
+                            geometry.describe(),
+                        )
+                    docs = chain(head, it)
 
             mesh = data_mesh() if len(jax.devices()) > 1 else None
             kwargs = {} if buckets is None else {"buckets": buckets}

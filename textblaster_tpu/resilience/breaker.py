@@ -26,6 +26,7 @@ import threading
 import time
 
 from ..utils.metrics import METRICS
+from ..utils.trace import TRACER
 
 logger = logging.getLogger(__name__)
 
@@ -94,6 +95,7 @@ class CircuitBreaker:
                 return False
             self._state = _HALF_OPEN
         METRICS.inc("resilience_breaker_probe_total")
+        TRACER.instant("breaker_probe", {"breaker": self.name})
         logger.warning(
             "Circuit breaker '%s' half-open after %.1fs cooldown; probing "
             "the device with one batch.",
@@ -109,6 +111,7 @@ class CircuitBreaker:
                 return
             self._state = _CLOSED
         METRICS.inc("resilience_breaker_recoveries_total")
+        TRACER.instant("breaker_recovery", {"breaker": self.name})
         METRICS.set("resilience_breaker_open", 0)
         logger.warning(
             "Circuit breaker '%s' closed: half-open probe succeeded; "
@@ -134,6 +137,7 @@ class CircuitBreaker:
                 reopened = False
         if reopened:
             METRICS.set("resilience_breaker_open", 1)
+            TRACER.instant("breaker_reopen", {"breaker": self.name})
             logger.error(
                 "Circuit breaker '%s' reopened: half-open probe failed%s; "
                 "cooling down for %.1fs.",
@@ -143,6 +147,8 @@ class CircuitBreaker:
             )
             return
         METRICS.inc("resilience_breaker_trips_total")
+        TRACER.instant("breaker_trip",
+                       {"breaker": self.name, "cause": cause})
         METRICS.set("resilience_breaker_open", 1)
         logger.error(
             "Circuit breaker '%s' tripped after %d consecutive failures%s; "

@@ -54,6 +54,7 @@ from typing import Callable, Dict, Optional, Sequence
 import numpy as np
 
 from ..utils.metrics import METRICS
+from ..utils.trace import TRACER
 from .breaker import CircuitBreaker
 from .retry import RetryPolicy, classify_error
 
@@ -170,8 +171,14 @@ class NegotiatedGuard:
             if not self._negotiate(local_fault):
                 self.breakers[bucket].record_success()
                 return stats
+            TRACER.instant(
+                "negotiated_verdict",
+                {"bucket": bucket, "local_fault": local_fault,
+                 "attempt": attempt},
+            )
             if attempt >= self.policy.max_retries:
                 METRICS.inc("resilience_negotiated_degraded_rounds_total")
+                TRACER.instant("negotiated_degraded", {"bucket": bucket})
                 self.breakers[bucket].record_failure(
                     "negotiated round retries exhausted"
                 )
@@ -185,6 +192,10 @@ class NegotiatedGuard:
             delay = self.policy.delay_for(attempt)
             attempt += 1
             METRICS.inc("resilience_negotiated_retries_total")
+            TRACER.instant(
+                "negotiated_retry",
+                {"bucket": bucket, "attempt": attempt, "backoff_s": delay},
+            )
             logger.warning(
                 "Negotiated retry %d/%d of lockstep round (bucket %s) on "
                 "all hosts, shared backoff %.3fs.",

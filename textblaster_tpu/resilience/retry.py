@@ -28,6 +28,7 @@ from ..errors import (
     StepError,
 )
 from ..utils.metrics import METRICS
+from ..utils.trace import TRACER
 
 logger = logging.getLogger(__name__)
 
@@ -187,6 +188,10 @@ class RetryPolicy:
                 attempt += 1
                 METRICS.inc("resilience_retries_total")
                 METRICS.inc(f"resilience_retries_{seam}_total")
+                TRACER.instant(
+                    "retry", {"seam": seam, "attempt": attempt,
+                              "error": type(e).__name__}
+                )
                 logger.warning(
                     "Transient fault at seam '%s' (attempt %d/%d, backing off "
                     "%.3fs): %s",

@@ -9,7 +9,9 @@ failure is logged, not fatal (rs:186-195 parity).
 
 from __future__ import annotations
 
+import json
 import logging
+import os
 import threading
 from collections import defaultdict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -29,6 +31,16 @@ __all__ = [
     "occupancy_snapshot",
     "occupancy_report",
     "format_occupancy_summary",
+    "FILTER_DROP_PREFIX",
+    "funnel_snapshot",
+    "funnel_report",
+    "format_funnel_summary",
+    "metrics_snapshot",
+    "resilience_report",
+    "build_run_report",
+    "write_run_report",
+    "RUN_REPORT_SCHEMA",
+    "metrics_catalog_markdown",
 ]
 
 # Histogram buckets mirroring the reference's defaults (prometheus crate).
@@ -239,6 +251,13 @@ _SPECS: Dict[str, Tuple[str, str]] = {
 #: the occupancy report discover them by this prefix.
 OCCUPANCY_BUCKET_PREFIX = "occupancy_dispatches_bucket_"
 
+#: Per-filter drop counters are dynamic too — one counter per filter name
+#: (``filter_dropped_total_<name>``), incremented at the exact two seams
+#: that create a FILTERED outcome (orchestration.execute_processing_pipeline
+#: and ops/pipeline._assemble_row), so their sum equals the excluded-Parquet
+#: row count by construction.
+FILTER_DROP_PREFIX = "filter_dropped_total_"
+
 #: The per-stage wall-time counters, in pipeline order.
 STAGE_COUNTERS = (
     "stage_read_seconds",
@@ -255,8 +274,25 @@ def stage_snapshot() -> Dict[str, float]:
     return {name: METRICS.get(name) for name in STAGE_COUNTERS}
 
 
+def _delta_fn(baseline, values):
+    """Shared resolver for the report helpers: with ``values`` (an already
+    materialized name->value dict, e.g. a summed cross-host snapshot) read
+    from it and apply ``baseline``; otherwise read the live registry."""
+    base = baseline or {}
+    if values is not None:
+        return lambda name: max(0.0, float(values.get(name, 0.0)) - base.get(name, 0.0))
+    return lambda name: max(0.0, METRICS.get(name) - base.get(name, 0.0))
+
+
+def _prefixed_from(values: Optional[Dict[str, float]], prefix: str) -> Dict[str, float]:
+    if values is not None:
+        return {k: float(v) for k, v in values.items() if k.startswith(prefix)}
+    return METRICS.prefixed(prefix)
+
+
 def stage_breakdown(
     baseline: Optional[Dict[str, float]] = None,
+    values: Optional[Dict[str, float]] = None,
 ) -> Dict[str, object]:
     """Per-stage seconds (optionally relative to a snapshot) plus a
     host-bound vs device-bound verdict.
@@ -268,11 +304,8 @@ def stage_breakdown(
     "host-bound" when host work dominates, "device-bound" when the device
     wait does, "balanced" within 20%.
     """
-    base = baseline or {}
-    stages = {
-        name: max(0.0, METRICS.get(name) - base.get(name, 0.0))
-        for name in STAGE_COUNTERS
-    }
+    delta = _delta_fn(baseline, values)
+    stages = {name: delta(name) for name in STAGE_COUNTERS}
     device_s = stages["stage_device_wait_seconds"]
     post_host = max(0.0, stages["stage_post_seconds"] - device_s)
     host_s = (
@@ -329,6 +362,7 @@ def occupancy_snapshot() -> Dict[str, float]:
 
 def occupancy_report(
     baseline: Optional[Dict[str, float]] = None,
+    values: Optional[Dict[str, float]] = None,
 ) -> Dict[str, object]:
     """Device-occupancy summary, optionally relative to a snapshot.
 
@@ -336,15 +370,13 @@ def occupancy_report(
     padding rather than document text — the quantity the calibrated
     geometry minimizes."""
     base = baseline or {}
-
-    def delta(name: str) -> float:
-        return max(0.0, METRICS.get(name) - base.get(name, 0.0))
+    delta = _delta_fn(baseline, values)
 
     lanes = delta("occupancy_padded_lanes_total")
     real = delta("occupancy_real_codepoints_total")
     per_bucket = {}
     for name, value in sorted(
-        METRICS.prefixed(OCCUPANCY_BUCKET_PREFIX).items(),
+        _prefixed_from(values, OCCUPANCY_BUCKET_PREFIX).items(),
         key=lambda kv: int(kv[0][len(OCCUPANCY_BUCKET_PREFIX):]),
     ):
         d = value - base.get(name, 0.0)
@@ -374,6 +406,146 @@ def format_occupancy_summary(
         f"{occ['device_batches']} dispatches"
         + (f" [bucket x dispatches: {buckets}]." if buckets else ".")
     )
+
+
+def funnel_snapshot() -> Dict[str, float]:
+    """Current values of every per-filter drop counter — the ``baseline``
+    argument for a scoped ``funnel_report``."""
+    return METRICS.prefixed(FILTER_DROP_PREFIX)
+
+
+def funnel_report(
+    baseline: Optional[Dict[str, float]] = None,
+    values: Optional[Dict[str, float]] = None,
+) -> Dict[str, object]:
+    """Per-filter drop attribution.  ``dropped_total`` equals the number of
+    FILTERED outcomes (= excluded-Parquet rows) because the counters are
+    incremented at the exact seams that create those outcomes."""
+    base = baseline or {}
+    per_filter: Dict[str, int] = {}
+    for name, value in _prefixed_from(values, FILTER_DROP_PREFIX).items():
+        d = value - base.get(name, 0.0)
+        if d > 0:
+            per_filter[name[len(FILTER_DROP_PREFIX):]] = int(d)
+    per_filter = dict(
+        sorted(per_filter.items(), key=lambda kv: (-kv[1], kv[0]))
+    )
+    return {
+        "per_filter_dropped": per_filter,
+        "dropped_total": int(sum(per_filter.values())),
+    }
+
+
+def format_funnel_summary(
+    baseline: Optional[Dict[str, float]] = None,
+    order: Optional[List[str]] = None,
+) -> str:
+    """Human-readable per-filter drop funnel for the CLI tail.  ``order``
+    (the pipeline's step sequence) pins the display order; filters that
+    dropped nothing are listed with 0 so the funnel reads as the config."""
+    rep = funnel_report(baseline)
+    per = dict(rep["per_filter_dropped"])
+    names = list(order) if order else []
+    names += [n for n in per if n not in names]
+    total = rep["dropped_total"]
+    lines = [f"Filter funnel ({total:,} documents dropped):"]
+    for name in names:
+        n = per.get(name, 0)
+        share = f" ({n / total:.1%})" if total else ""
+        lines.append(f"  {name:<24} {n:>9,}{share if n else ''}")
+    if not names:
+        lines.append("  (no filter drops recorded)")
+    return "\n".join(lines)
+
+
+def metrics_snapshot() -> Dict[str, float]:
+    """Full copy of every counter/gauge (dynamic families included) —
+    the unit of cross-host exchange and the run-report baseline.
+    Histogram state is deliberately excluded (not needed by any report)."""
+    return METRICS.all_values()
+
+
+#: Counter families surfaced in the run report's resilience section.
+_RESILIENCE_REPORT_PREFIXES = ("resilience_", "deadletter_", "multihost_")
+
+
+def resilience_report(
+    baseline: Optional[Dict[str, float]] = None,
+    values: Optional[Dict[str, float]] = None,
+) -> Dict[str, int]:
+    """Every resilience/dead-letter/multihost counter as an int delta."""
+    delta = _delta_fn(baseline, values)
+    out: Dict[str, int] = {}
+    for name, (mtype, _help) in _SPECS.items():
+        if name.startswith(_RESILIENCE_REPORT_PREFIXES) and mtype == "counter":
+            out[name] = int(delta(name))
+    return out
+
+
+#: Schema identifier stamped into every run report (bump on breaking shape
+#: changes; consumers should match on it, not on key presence).
+RUN_REPORT_SCHEMA = "textblaster-run-report/v1"
+
+
+def build_run_report(
+    *,
+    baseline: Optional[Dict[str, float]] = None,
+    values: Optional[Dict[str, float]] = None,
+    wall_time_s: Optional[float] = None,
+    counts: Optional[Dict[str, int]] = None,
+    provenance: Optional[Dict[str, object]] = None,
+    hosts: Optional[List[Dict[str, object]]] = None,
+) -> Dict[str, object]:
+    """Machine-readable end-of-run artifact (the ``--run-report`` payload).
+
+    Reads the live registry relative to ``baseline`` by default; pass
+    ``values`` (e.g. per-host deltas summed across an allgather) to build
+    the same report from a materialized snapshot instead.  ``hosts``
+    attaches the per-host snapshots on the multihost merged report."""
+    report: Dict[str, object] = {
+        "schema": RUN_REPORT_SCHEMA,
+        "wall_time_s": round(wall_time_s, 3) if wall_time_s is not None else None,
+        "counts": dict(counts or {}),
+        "stages": stage_breakdown(baseline, values),
+        "occupancy": occupancy_report(baseline, values),
+        "resilience": resilience_report(baseline, values),
+        "funnel": funnel_report(baseline, values),
+        "config": dict(provenance or {}),
+    }
+    if hosts is not None:
+        report["hosts"] = hosts
+        report["num_hosts"] = len(hosts)
+    return report
+
+
+def write_run_report(path: str, report: Dict[str, object]) -> None:
+    """Write the report as pretty-printed JSON (parents created)."""
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(report, f, indent=2, sort_keys=False)
+        f.write("\n")
+
+
+def metrics_catalog_markdown() -> str:
+    """Markdown table of every metric — the README catalog is generated
+    from this (``python -m textblaster_tpu.utils.metrics``) so the docs
+    cannot drift from ``_SPECS``."""
+    lines = [
+        "| Metric | Type | Description |",
+        "| --- | --- | --- |",
+    ]
+    for name, (mtype, help_text) in _SPECS.items():
+        lines.append(f"| `{name}` | {mtype} | {help_text} |")
+    lines.append(
+        f"| `{OCCUPANCY_BUCKET_PREFIX}<L>` | counter | Dynamic family: "
+        "device dispatches at bucket length `<L>` |"
+    )
+    lines.append(
+        f"| `{FILTER_DROP_PREFIX}<name>` | counter | Dynamic family: "
+        "documents dropped by filter `<name>` |"
+    )
+    return "\n".join(lines)
 
 
 class Metrics:
@@ -408,6 +580,11 @@ class Metrics:
             return {
                 k: v for k, v in self._values.items() if k.startswith(prefix)
             }
+
+    def all_values(self) -> Dict[str, float]:
+        """Copy of every counter/gauge value (histograms excluded)."""
+        with self._lock:
+            return dict(self._values)
 
     def observe(self, name: str, value: float) -> None:
         with self._lock:
@@ -453,8 +630,8 @@ class Metrics:
                     lines.append(f'{name}_bucket{{le="+Inf"}} {cumulative}')
                     lines.append(f"{name}_sum {self._hist_sum.get(name, 0.0):g}")
                     lines.append(f"{name}_count {self._hist_total.get(name, 0)}")
-            # Dynamic per-bucket occupancy counters (one per dispatched
-            # bucket length — the set is only known at runtime).
+            # Dynamic counter families — the member sets are only known at
+            # runtime (buckets actually dispatched, filters that dropped).
             dyn = sorted(
                 (k for k in self._values if k.startswith(OCCUPANCY_BUCKET_PREFIX)),
                 key=lambda k: int(k[len(OCCUPANCY_BUCKET_PREFIX):]),
@@ -466,6 +643,15 @@ class Metrics:
                 )
                 lines.append(f"# TYPE {name} counter")
                 lines.append(f"{name} {self._values[name]:g}")
+            for name in sorted(
+                k for k in self._values if k.startswith(FILTER_DROP_PREFIX)
+            ):
+                lines.append(
+                    f"# HELP {name} Documents dropped by filter "
+                    f"{name[len(FILTER_DROP_PREFIX):]}"
+                )
+                lines.append(f"# TYPE {name} counter")
+                lines.append(f"{name} {self._values[name]:g}")
             return "\n".join(lines) + "\n"
 
 
@@ -474,8 +660,13 @@ METRICS = Metrics()
 
 
 class _Handler(BaseHTTPRequestHandler):
-    def do_GET(self):  # noqa: N802
-        if self.path != "/metrics":
+    def _is_metrics_path(self) -> bool:
+        # Strict scrapers send query strings (GET /metrics?timeout=5) —
+        # match on the path component only.
+        return self.path.split("?", 1)[0] == "/metrics"
+
+    def _respond(self, send_body: bool) -> None:
+        if not self._is_metrics_path():
             self.send_response(404)
             self.end_headers()
             return
@@ -484,7 +675,14 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Type", "text/plain; version=0.0.4")
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
-        self.wfile.write(body)
+        if send_body:
+            self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802
+        self._respond(send_body=True)
+
+    def do_HEAD(self):  # noqa: N802 — probes (curl -I, LB health checks)
+        self._respond(send_body=False)
 
     def log_message(self, fmt, *args):  # silence request logging
         logger.debug("metrics: " + fmt, *args)
@@ -506,3 +704,7 @@ def setup_prometheus_metrics(port: Optional[int]) -> Optional[ThreadingHTTPServe
     thread.start()
     logger.info("Metrics server listening on port %s", port)
     return server
+
+
+if __name__ == "__main__":  # README catalog generator
+    print(metrics_catalog_markdown())

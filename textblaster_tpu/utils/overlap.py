@@ -32,6 +32,7 @@ import threading
 from typing import Any, Iterable, Iterator, List, Optional
 
 from .metrics import METRICS
+from .trace import TRACER
 
 __all__ = ["prefetch_iter", "ThreadedWriter"]
 
@@ -165,6 +166,7 @@ class ThreadedWriter:
             finally:
                 self._queue.task_done()
                 METRICS.set("queue_depth_write", self._queue.qsize())
+                TRACER.counter("queue_depth_write", self._queue.qsize())
 
     def _raise_pending(self) -> None:
         if self._error is not None:
@@ -178,6 +180,7 @@ class ThreadedWriter:
         self._raise_pending()
         self._queue.put(list(outcomes))
         METRICS.set("queue_depth_write", self._queue.qsize())
+        TRACER.counter("queue_depth_write", self._queue.qsize())
 
     def close(self) -> None:
         if self._closed:
