@@ -1,0 +1,713 @@
+"""Elastic gang membership suite: leases, epochs, deadlines, restart-in-place.
+
+Four layers, mirroring how the membership machinery can fail:
+
+* **Unit** (fast): `PeerFailure` typing/formatting, the exchange
+  epoch/sequence state (`_ExchangeState`), the ragged-row guard,
+  `FileMembershipStore` lease lifecycle (injectable clock),
+  `stripe_owner`'s deterministic adoption rule, `EpochTracker` bumps, and
+  `CheckpointState.adopt` claim semantics.
+* **In-process integration**: one live rank with ``num_processes=2`` must
+  adopt the orphaned stripe from row 0 and produce oracle-identical
+  merged outputs.
+* **Subprocess KV**: a 1-process ``jax.distributed`` job exercising the
+  real coordination-service KV store — lease post/read/classify,
+  overwrite-renewal, and key deletion (the hygiene `host_allgather` relies
+  on).
+* **2-process chaos** (slow): a real SIGKILL mid-run under ``--elastic``
+  (survivor evicts, adopts, merges; outputs byte-identical to a fault-free
+  single-host run), restart-in-place (the relaunched rank resumes its
+  cursor replaying zero committed chunks), and — without ``--elastic`` —
+  the deadline-bounded exchange failing fast with a typed ``PeerFailure``
+  naming the dead rank well inside the old 300 s hang.
+
+The spawn helpers are standalone copies of tests/test_multihost_chaos.py's
+(same env contract: forced CPU platform, 4 forced devices per process) —
+importing across test modules would couple the suites' lifecycles.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import select
+import signal
+import socket
+import subprocess
+import sys
+import textwrap
+import time
+from pathlib import Path
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from textblaster_tpu.checkpoint import CheckpointState
+from textblaster_tpu.config.pipeline import parse_pipeline_config
+from textblaster_tpu.data_model import ProcessingOutcome, TextDocument
+from textblaster_tpu.errors import CheckpointError, PeerFailure, PipelineError
+from textblaster_tpu.orchestration import process_documents_host
+from textblaster_tpu.parallel import multihost
+from textblaster_tpu.pipeline_builder import build_pipeline_from_config
+from textblaster_tpu.resilience import FAULTS
+from textblaster_tpu.resilience.membership import (
+    EpochTracker,
+    FileMembershipStore,
+    MembershipConfig,
+    stripe_owner,
+)
+from textblaster_tpu.utils.metrics import METRICS
+
+REPO = Path(__file__).parent.parent
+
+YAML = """
+pipeline:
+  - type: LanguageDetectionFilter
+    min_confidence: 0.5
+    allowed_languages: [ "dan", "eng" ]
+  - type: GopherQualityFilter
+    min_doc_words: 4
+    min_stop_words: 1
+    stop_words: [ "og", "the", "er", "i" ]
+"""
+
+
+def _docs(n=48):
+    base = [
+        "Det er en god dag i dag, og vi skal ud at gå en lang tur i skoven nu.",
+        "The quick brown fox jumps over the lazy dog and the old stone bridge.",
+        "kort.",
+        "Endnu en dansk tekst om vejret, og den er ganske lang og fin at læse.",
+        "Vi mødes nede ved havnen i morgen, og så sejler vi ud på vandet.",
+        ("En meget lang dansk tekst om byen og havnen og vejret, og den "
+         "bliver ved i mange ord. ") * 12,
+    ]
+    rng = np.random.default_rng(7)
+    docs = []
+    for i in range(n):
+        t = base[i % len(base)]
+        if rng.random() < 0.25:
+            t = t + " Og lidt mere tekst til sidst her."
+        docs.append(TextDocument(id=f"el-{i}", source="s", content=t))
+    return docs
+
+
+# --- PeerFailure -------------------------------------------------------------
+
+
+def test_peer_failure_is_typed_and_names_ranks():
+    e = PeerFailure(
+        "exchange e2/s3 deadline (15s) expired; rank(s) [1] never posted",
+        missing_ranks=(1,), dead_ranks=(1,), seq=3, epoch=2,
+    )
+    assert isinstance(e, PipelineError)
+    assert e.missing_ranks == (1,) and e.dead_ranks == (1,)
+    assert e.seq == 3 and e.epoch == 2
+    s = str(e)
+    assert s.startswith("Peer failure:")
+    assert "rank(s) [1]" in s and "e2/s3" in s
+
+
+# --- exchange epoch / sequence state -----------------------------------------
+
+
+@pytest.fixture()
+def _exchange_state():
+    """Reset the module-global exchange state around a test."""
+    multihost.configure_exchange(deadline_s=300.0, reset=True)
+    yield multihost._EXCHANGE
+    multihost.configure_exchange(deadline_s=300.0, reset=True)
+
+
+def test_exchange_epoch_namespaces_keys_and_restarts_seq(_exchange_state):
+    st = _exchange_state
+    assert multihost.current_exchange_epoch() == 0
+    st.seq = 5  # as if five exchanges completed in epoch 0
+    assert multihost.bump_exchange_epoch() == 1
+    assert st.seq == 0
+    # The drained epoch's last own key waits for its read-proof.
+    assert st.pending_delete == [(0, 4)]
+    # A bump with no exchanges since the last one queues nothing.
+    assert multihost.bump_exchange_epoch() == 2
+    assert st.pending_delete == [(0, 4)]
+    assert multihost._ag_key(2, 0, 1) == "textblast/allgather/e2/s0/1"
+
+
+def test_configure_exchange_reset_realigns_counters(_exchange_state):
+    st = _exchange_state
+    st.epoch, st.seq, st.pending_delete = 7, 3, [(6, 1)]
+    multihost.configure_exchange(deadline_s=12.5)
+    assert st.epoch == 0 and st.seq == 0 and st.pending_delete == []
+    assert st.deadline_s == 12.5
+    st.seq = 2
+    multihost.configure_exchange(reset=False)
+    assert st.seq == 2  # reset=False keeps shared round state intact
+
+
+def test_validate_rows_names_ragged_rank(_exchange_state):
+    multihost._validate_rows([[1, 2], [3, 4]], 2, seq=1, epoch=0)
+    with pytest.raises(PeerFailure) as ei:
+        multihost._validate_rows([[1, 2], [3]], 2, seq=4, epoch=1)
+    assert ei.value.missing_ranks == (1,)
+    assert "rank 1" in str(ei.value) and "e1/s4" in str(ei.value)
+
+
+def test_raise_peer_failure_counts_and_reports(_exchange_state):
+    before = METRICS.get("multihost_peer_failures_total")
+    with pytest.raises(PeerFailure) as ei:
+        multihost._raise_peer_failure(
+            [1, 3], seq=2, epoch=1, deadline_s=15.0,
+            transport_error="DEADLINE_EXCEEDED: kv get timed out",
+        )
+    assert METRICS.get("multihost_peer_failures_total") - before == 1
+    s = str(ei.value)
+    assert "rank(s) [1, 3]" in s and "15s" in s
+    assert "DEADLINE_EXCEEDED" in s
+    # No lease store configured: no dead/slow classification is claimed.
+    assert ei.value.dead_ranks == ()
+
+
+# --- MembershipConfig --------------------------------------------------------
+
+
+def test_membership_config_validation_and_interval():
+    cfg = MembershipConfig(lease_ttl_s=9.0)
+    assert cfg.heartbeat_interval_s() == 3.0
+    assert MembershipConfig(lease_ttl_s=0.06).heartbeat_interval_s() == 0.05
+    with pytest.raises(PipelineError):
+        MembershipConfig(lease_ttl_s=0).validate()
+    with pytest.raises(PipelineError):
+        MembershipConfig(exchange_deadline_s=-1).validate()
+
+
+# --- FileMembershipStore -----------------------------------------------------
+
+
+def test_file_leases_register_renew_expire(tmp_path):
+    root = str(tmp_path / "membership")
+    a = FileMembershipStore(root, 0, ttl_s=5.0)
+    b = FileMembershipStore(root, 1, ttl_s=5.0)
+    a.register()
+    b.register()
+    now = time.time()
+    assert a.live_ranks(now=now) == [0, 1]
+    assert a.my_lease_fresh(now=now)
+    # Rank 1 stops renewing: past the TTL it drops out of the live set.
+    assert a.live_ranks(now=now + 6.0) == []
+    # Backdate rank 1's lease past the TTL: it alone drops out.
+    lease1 = Path(root) / "lease.rank1.json"
+    d = json.loads(lease1.read_text(encoding="utf-8"))
+    d["time"] -= 10.0
+    lease1.write_text(json.dumps(d), encoding="utf-8")
+    a.post()
+    assert a.live_ranks(now=time.time()) == [0]
+    # A newer incarnation of rank 0 fences the old one out.
+    a2 = FileMembershipStore(root, 0, ttl_s=5.0)
+    a2.register()
+    assert not a.my_lease_fresh(now=time.time())
+    assert a2.my_lease_fresh(now=time.time())
+    a2.withdraw()
+    assert a2.live_ranks(now=time.time()) == []
+
+
+def test_file_store_t0_written_once(tmp_path):
+    root = str(tmp_path / "membership")
+    a = FileMembershipStore(root, 0, ttl_s=5.0)
+    a.register()
+    t0 = a.t0_us()
+    assert t0 is not None and t0 > 0
+    time.sleep(0.01)
+    b = FileMembershipStore(root, 1, ttl_s=5.0)
+    b.register()
+    assert b.t0_us() == t0  # the first registrant's origin is the run's
+
+
+def test_lease_renewal_fault_site(tmp_path):
+    store = FileMembershipStore(str(tmp_path / "m"), 0, ttl_s=5.0)
+    FAULTS.inject("multihost.lease", OSError("injected lease outage"))
+    try:
+        with pytest.raises(OSError):
+            store.register()
+    finally:
+        FAULTS.reset()
+    store.register()  # disarmed: renewal works again
+
+
+# --- ownership + epochs ------------------------------------------------------
+
+
+def test_stripe_owner_rule():
+    assert stripe_owner(1, [0, 1]) == 1  # own stripe while live
+    assert stripe_owner(1, [0]) == 0     # orphan -> lowest live rank
+    assert stripe_owner(0, [2, 3]) == 2
+    assert stripe_owner(2, []) is None
+
+
+def test_epoch_tracker_bumps_on_membership_change():
+    t = EpochTracker(0)
+    assert t.epoch == 1
+    assert t.observe([0, 1]) == []  # first observation seeds, no bump
+    assert t.observe([0, 1]) == []
+    ev = t.observe([0])
+    assert t.epoch == 2 and len(ev) == 1 and "evicted rank 1" in ev[0]
+    ev = t.observe([0, 1])
+    assert t.epoch == 3 and len(ev) == 1 and "rank 1 rejoined" in ev[0]
+
+
+# --- CheckpointState.adopt ---------------------------------------------------
+
+
+def test_cursor_adopt_claims_and_preserves_work(tmp_path):
+    d = str(tmp_path)
+    fp = {"path": "/in.parquet", "size": 1, "mtime_ns": 2, "num_rows": 48}
+    owner_a = {"rank": 1, "incarnation": "aaa"}
+    st = CheckpointState.adopt(d, owner_a, input_fingerprint=fp,
+                               config_hash="h1")
+    assert st.owner == owner_a and st.rows_consumed == 0
+    st.rows_consumed, st.success = 16, 10
+    st.save(d)
+    # Adoption by another owner keeps committed work verbatim.
+    owner_b = {"rank": 0, "incarnation": "bbb"}
+    st2 = CheckpointState.adopt(d, owner_b, input_fingerprint=fp,
+                                config_hash="h1")
+    assert st2.owner == owner_b
+    assert st2.rows_consumed == 16 and st2.success == 10
+    # Fingerprint / config mismatches fail fast naming the directory.
+    with pytest.raises(CheckpointError):
+        CheckpointState.adopt(d, owner_b, input_fingerprint={**fp, "size": 9},
+                              config_hash="h1")
+    with pytest.raises(CheckpointError):
+        CheckpointState.adopt(d, owner_b, input_fingerprint=fp,
+                              config_hash="OTHER")
+
+
+def test_adopt_fault_site(tmp_path):
+    fp = {"path": "/in.parquet", "size": 1, "mtime_ns": 2, "num_rows": 8}
+    FAULTS.inject("multihost.rejoin", OSError("injected claim outage"))
+    try:
+        with pytest.raises(OSError):
+            CheckpointState.adopt(str(tmp_path), {"rank": 0, "incarnation": "x"},
+                                  input_fingerprint=fp, config_hash="h")
+    finally:
+        FAULTS.reset()
+
+
+# --- in-process integration: orphan adoption ---------------------------------
+
+
+def _host_oracle(yaml_text, docs):
+    kept, exc = {}, {}
+    config = parse_pipeline_config(yaml_text)
+    for o in process_documents_host(
+        build_pipeline_from_config(config), iter([d.copy() for d in docs])
+    ):
+        d = o.document
+        if o.kind == ProcessingOutcome.SUCCESS:
+            kept[d.id] = (d.content, d.metadata)
+        elif o.kind == ProcessingOutcome.FILTERED:
+            exc[d.id] = (d.content, d.metadata)
+    return kept, exc
+
+
+def _rows(path):
+    return {
+        r["id"]: (
+            r["text"],
+            json.loads(r["metadata"]) if r["metadata"] else {},
+        )
+        for r in pq.read_table(path).to_pylist()
+    }
+
+
+def _write_input(tmp_path, docs, null_text_rows=()):
+    inp = tmp_path / "input.parquet"
+    nulls = set(null_text_rows)
+    pq.write_table(
+        pa.table(
+            {
+                "id": [d.id for d in docs],
+                "text": [
+                    None if i in nulls else d.content
+                    for i, d in enumerate(docs)
+                ],
+                "source": [d.source for d in docs],
+            }
+        ),
+        inp,
+    )
+    return inp
+
+
+def test_elastic_single_survivor_adopts_orphan_stripe(tmp_path):
+    """num_processes=2 but only rank 0 ever runs: stripe 1 has no live
+    owner, so rank 0 must adopt it from row 0 and merge both stripes into
+    oracle-identical finals — the degenerate (but fully exercising) form
+    of the SIGKILL scenario, without subprocesses."""
+    docs = _docs(32)
+    inp = _write_input(tmp_path, docs)
+    out = tmp_path / "kept.parquet"
+    exc = tmp_path / "excluded.parquet"
+    config = parse_pipeline_config(YAML)
+    adopted_before = METRICS.get("multihost_adopted_stripes_total")
+    result = multihost.run_multihost(
+        config, str(inp), str(out), str(exc),
+        coordinator="localhost:1",  # accepted, unused under --elastic
+        num_processes=2, process_id=0,
+        buckets=(512, 2048), read_batch_size=8,
+        elastic=True, lease_ttl_s=2.0,
+    )
+    assert METRICS.get("multihost_adopted_stripes_total") - adopted_before == 1
+    assert not os.path.exists(str(out) + ".membership")
+    kept, excluded = _rows(out), _rows(exc)
+    host_kept, host_exc = _host_oracle(YAML, docs)
+    assert set(kept) == set(host_kept)
+    assert set(excluded) == set(host_exc)
+    for k, v in host_kept.items():
+        assert kept[k] == v, k
+    for k, v in host_exc.items():
+        assert excluded[k] == v, k
+    assert result.received == len(docs)
+    assert result.success == len(host_kept)
+
+
+def test_elastic_rejects_collective_only_features(tmp_path):
+    docs = _docs(4)
+    inp = _write_input(tmp_path, docs)
+    config = parse_pipeline_config(YAML)
+    with pytest.raises(PipelineError, match="--elastic is incompatible"):
+        multihost.run_multihost(
+            config, str(inp), str(tmp_path / "o.parquet"),
+            str(tmp_path / "e.parquet"),
+            coordinator="localhost:1", num_processes=2, process_id=0,
+            elastic=True, run_report=str(tmp_path / "r.json"),
+        )
+
+
+# --- subprocess: real coordination-service KV leases -------------------------
+
+
+KV_SCRIPT = textwrap.dedent(
+    """
+    import time
+    import jax
+    jax.distributed.initialize("localhost:%PORT%", num_processes=1,
+                               process_id=0)
+    from jax._src import distributed
+    from textblaster_tpu.resilience.membership import KVLeaseStore, _kv_set
+
+    client = distributed.global_state.client
+    store = KVLeaseStore(client, 0, ttl_s=2.0)
+    store.post()
+    store.post()  # overwrite-renewal must not raise (allow_overwrite)
+    leases = store.read_all()
+    assert 0 in leases, leases
+    dead, slow = store.resolve_liveness([0, 1])
+    assert dead == [1] and slow == [0], (dead, slow)
+    dead, _ = store.resolve_liveness([0], now=time.time() + 10.0)
+    assert dead == [0]  # stale lease classified dead
+    # Key hygiene: set + delete roundtrip (host_allgather's cleanup path).
+    _kv_set(client, "textblast/allgather/e0/s0/0", "1,2")
+    assert client.blocking_key_value_get(
+        "textblast/allgather/e0/s0/0", 2000) == "1,2"
+    client.key_value_delete("textblast/allgather/e0/s0/0")
+    print("KV_OK")
+    """
+)
+
+
+@pytest.mark.slow
+def test_kv_lease_store_against_real_coordination_service(tmp_path):
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    script = KV_SCRIPT.replace("%PORT%", str(port))
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        cwd=str(REPO),
+        env={
+            "JAX_PLATFORMS": "cpu",
+            "PATH": "/usr/bin:/bin:/usr/local/bin",
+            "HOME": "/root",
+        },
+        capture_output=True, text=True, timeout=180,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "KV_OK" in proc.stdout
+
+
+# --- 2-process chaos ---------------------------------------------------------
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_rank(tmp_path, pid, port, extra_args=(), num_processes=2):
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        "PATH": "/usr/bin:/bin:/usr/local/bin",
+        "HOME": "/root",
+    }
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "textblaster_tpu.cli", "run",
+            "--coordinator", f"localhost:{port}",
+            "--num-processes", str(num_processes),
+            "--process-id", str(pid),
+            "-i", str(tmp_path / "input.parquet"),
+            "-o", str(tmp_path / "kept.parquet"),
+            "-e", str(tmp_path / "excluded.parquet"),
+            "-c", str(tmp_path / "cfg.yaml"),
+            "--buckets", "512,2048",
+            "--quiet",
+            *extra_args,
+        ],
+        cwd=str(REPO),
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+
+def _read_until(proc, pattern, timeout, sink):
+    """Stream a process's merged output into ``sink`` until ``pattern``
+    matches a line (returns the match) or the timeout/EOF hits (None)."""
+    rx = re.compile(pattern)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        r, _, _ = select.select([proc.stdout], [], [], 0.5)
+        if not r:
+            if proc.poll() is not None:
+                return None
+            continue
+        line = proc.stdout.readline()
+        if not line:
+            return None
+        sink.append(line)
+        m = rx.search(line)
+        if m:
+            return m
+    return None
+
+
+def _drain(proc, sink, timeout):
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+    if out:
+        sink.append(out)
+    return "".join(sink)
+
+
+def _single_host_reference(tmp_path, docs, null_text_rows=()):
+    """Fault-free single-host CLI run — the byte-parity reference."""
+    ref = tmp_path / "ref"
+    ref.mkdir()
+    (ref / "cfg.yaml").write_text(YAML, encoding="utf-8")
+    _write_input(ref, docs, null_text_rows)
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "textblaster_tpu.cli", "run",
+            "-i", str(ref / "input.parquet"),
+            "-o", str(ref / "kept.parquet"),
+            "-e", str(ref / "excluded.parquet"),
+            "-c", str(ref / "cfg.yaml"),
+            "--buckets", "512,2048",
+            "--errors-file", str(ref / "errors.parquet"),
+            "--quiet",
+        ],
+        cwd=str(REPO),
+        env={
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "PATH": "/usr/bin:/bin:/usr/local/bin",
+            "HOME": "/root",
+        },
+        capture_output=True, text=True, timeout=560,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    return ref / "kept.parquet", ref / "excluded.parquet", ref / "errors.parquet"
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_elastic_sigkill_survivor_adopts_and_matches_single_host(tmp_path):
+    """The ISSUE acceptance scenario: SIGKILL rank 1 mid-run under
+    ``--elastic``; rank 0 must evict it within the lease TTL, adopt its
+    stripe at the committed cursor, and complete alone — with merged
+    outputs identical to a fault-free single-host run of the same input
+    (and the dead-lettered rows all present exactly once)."""
+    docs = _docs(64)
+    nulls = (3, 40)  # one unreadable row per stripe
+    (tmp_path / "cfg.yaml").write_text(YAML, encoding="utf-8")
+    _write_input(tmp_path, docs, nulls)
+    port = _free_port()
+    args = (
+        "--elastic", "--lease-ttl-s", "3", "--batch-size", "8",
+        "--errors-file", str(tmp_path / "errors.parquet"),
+    )
+    p0 = _spawn_rank(tmp_path, 0, port, args)
+    p1 = _spawn_rank(tmp_path, 1, port, args)
+    sink0, sink1 = [], []
+    try:
+        # Let rank 1 commit at least one chunk, then SIGKILL it.
+        m = _read_until(
+            p1, r"stripe 1 committed rows (\d+)/(\d+)", timeout=420,
+            sink=sink1,
+        )
+        if m is None:
+            pytest.skip(
+                "rank 1 finished/never committed before the kill could land:\n"
+                + "".join(sink1)[-1500:]
+            )
+        committed = int(m.group(1))
+        take = int(m.group(2))
+        if committed >= take:
+            pytest.skip("rank 1's stripe completed in one chunk")
+        os.kill(p1.pid, signal.SIGKILL)
+        out0 = _drain(p0, sink0, timeout=420)
+        assert p0.returncode == 0, out0[-3000:]
+    finally:
+        for p in (p0, p1):
+            if p.poll() is None:
+                p.kill()
+        _drain(p1, sink1, timeout=30)
+
+    assert "evicted rank 1" in out0
+    assert re.search(r"adopted stripe 1 at row \d+/", out0)
+    # Adoption resumed at (at least) the committed cursor — nothing replayed.
+    adopt_row = int(re.search(r"adopted stripe 1 at row (\d+)/", out0).group(1))
+    assert adopt_row >= committed
+    assert "Elastic membership:" in out0  # CLI churn summary line
+
+    ref_out, ref_exc, ref_err = _single_host_reference(tmp_path, docs, nulls)
+    assert _rows(tmp_path / "kept.parquet") == _rows(ref_out)
+    assert _rows(tmp_path / "excluded.parquet") == _rows(ref_exc)
+    # Read-error dead letters carry no id (the row never parsed): compare
+    # the merged quarantine by count and step against the reference run.
+    err_rows = pq.read_table(tmp_path / "errors.parquet").to_pylist()
+    ref_err_rows = pq.read_table(ref_err).to_pylist()
+    assert len(err_rows) == len(nulls) == len(ref_err_rows)
+    assert sorted(r["step"] for r in err_rows) == sorted(
+        r["step"] for r in ref_err_rows
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_elastic_restart_in_place_replays_zero_chunks(tmp_path):
+    """Restart-in-place: SIGKILL rank 1 after a committed chunk, relaunch
+    the identical command, and the new incarnation must resume its stripe
+    from the committed cursor — its first commit strictly past the
+    predecessor's — with the run completing and matching the oracle."""
+    docs = _docs(64)
+    (tmp_path / "cfg.yaml").write_text(YAML, encoding="utf-8")
+    _write_input(tmp_path, docs)
+    port = _free_port()
+    # Generous TTL so the relaunch usually lands before eviction — but the
+    # protocol (and this test) tolerates rank 0 adopting in the gap.
+    args = ("--elastic", "--lease-ttl-s", "10", "--batch-size", "8")
+    p0 = _spawn_rank(tmp_path, 0, port, args)
+    p1 = _spawn_rank(tmp_path, 1, port, args)
+    sink0, sink1, sink1b = [], [], []
+    p1b = None
+    try:
+        m = _read_until(
+            p1, r"stripe 1 committed rows (\d+)/(\d+)", timeout=420,
+            sink=sink1,
+        )
+        if m is None:
+            pytest.skip(
+                "rank 1 finished/never committed before the kill could land:\n"
+                + "".join(sink1)[-1500:]
+            )
+        committed, take = int(m.group(1)), int(m.group(2))
+        if committed >= take:
+            pytest.skip("rank 1's stripe completed in one chunk")
+        os.kill(p1.pid, signal.SIGKILL)
+        p1b = _spawn_rank(tmp_path, 1, port, args)  # restart in place
+        m = _read_until(
+            p1b,
+            r"stripe 1 (resume at|committed rows) (\d+)/",
+            timeout=420,
+            sink=sink1b,
+        )
+        out0 = _drain(p0, sink0, timeout=420)
+        out1b = _drain(p1b, sink1b, timeout=120)
+        assert p0.returncode == 0, out0[-3000:]
+        assert p1b.returncode == 0, out1b[-3000:]
+    finally:
+        for p in (p0, p1, p1b):
+            if p is not None and p.poll() is None:
+                p.kill()
+        _drain(p1, sink1, timeout=30)
+
+    resume = re.search(r"stripe 1 resume at row (\d+)/", out1b)
+    if resume is not None:
+        # The relaunched rank reclaimed its own cursor: it resumed at (at
+        # least) the committed row and its first commit moved strictly
+        # past it — zero completed chunks replayed.
+        assert int(resume.group(1)) >= committed
+        first_commit = re.search(r"stripe 1 committed rows (\d+)/", out1b)
+        if first_commit is not None:
+            assert int(first_commit.group(1)) > committed
+    else:
+        # Rank 0 won the race and adopted the stripe — equally zero-replay
+        # (the adoption line carries the resumed row).
+        adopted = re.search(r"adopted stripe 1 at row (\d+)/", out0)
+        assert adopted is not None, (out0[-2000:], out1b[-2000:])
+        assert int(adopted.group(1)) >= committed
+
+    kept, excluded = (
+        _rows(tmp_path / "kept.parquet"),
+        _rows(tmp_path / "excluded.parquet"),
+    )
+    host_kept, host_exc = _host_oracle(YAML, docs)
+    assert kept == host_kept
+    assert excluded == host_exc
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_deadline_bounded_exchange_fails_fast_naming_dead_rank(tmp_path):
+    """Without ``--elastic``: a short ``--exchange-deadline-s`` must turn a
+    peer death into a typed PeerFailure naming the dead rank within the
+    deadline (plus probe slack) — far inside both the old hardcoded 300 s
+    get and the ~95 s coordination-service teardown."""
+    docs = _docs(4096)  # big enough that the kill lands mid-run
+    (tmp_path / "cfg.yaml").write_text(YAML, encoding="utf-8")
+    _write_input(tmp_path, docs)
+    port = _free_port()
+    args = ("--exchange-deadline-s", "15", "--lease-ttl-s", "3",
+            "--batch-size", "8")
+    p0 = _spawn_rank(tmp_path, 0, port, args)
+    p1 = _spawn_rank(tmp_path, 1, port, args)
+    sink0 = []
+    try:
+        time.sleep(8)  # both past the coordination barrier by now
+        if p1.poll() is not None or p0.poll() is not None:
+            pytest.skip("run completed before the kill could land")
+        killed_at = time.monotonic()
+        os.kill(p1.pid, signal.SIGKILL)
+        out0 = _drain(p0, sink0, timeout=90)
+        elapsed = time.monotonic() - killed_at
+        assert p0.returncode != 0, out0[-3000:]
+        assert "Peer failure:" in out0, out0[-3000:]
+        assert re.search(r"rank\(s\) \[1\]", out0), out0[-3000:]
+        assert re.search(r"exchange e\d+/s\d+", out0), out0[-3000:]
+        # lease TTL 3s << deadline 15s: rank 1 is classified dead, not slow.
+        assert "dead" in out0, out0[-3000:]
+        assert elapsed < 60, f"took {elapsed:.0f}s — not deadline-bounded"
+    finally:
+        for p in (p0, p1):
+            if p.poll() is None:
+                p.kill()

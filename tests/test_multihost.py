@@ -249,10 +249,13 @@ def test_uneven_bucket_distribution(tmp_path: Path):
 
 def test_one_process_crash_fails_fast_not_hang(tmp_path: Path):
     """Failure detection (VERDICT r4 item 6 scenario 3): when one process
-    dies mid-run, the survivor must NOT hang on the next allgather — the
-    jax coordination service notices the missed heartbeats and propagates
-    UNAVAILABLE to every healthy task, which exits nonzero.  Measured on
-    this box: ~94 s from kill to exit; the 360 s bound is generous."""
+    dies mid-run, the survivor must NOT hang on the next allgather — with
+    the default 300 s exchange deadline, the jax coordination service
+    notices the missed heartbeats first and propagates UNAVAILABLE to
+    every healthy task, which exits nonzero.  Measured on this box: ~94 s
+    from kill to exit; the 360 s bound is generous.  (The deadline-bounded
+    variant — a short --exchange-deadline-s turning the same death into a
+    typed PeerFailure — is tests/test_elastic_membership.py.)"""
     import time as _time
 
     docs = [
@@ -264,12 +267,12 @@ def test_one_process_crash_fails_fast_not_hang(tmp_path: Path):
                 "i skoven, og den er ganske fin at læse om vejret nu."
             ),
         )
-        for i in range(24)
+        for i in range(4096)
     ]
     procs, _, _, _ = _spawn_cli(tmp_path, docs, YAML, wait=False)
     try:
         _time.sleep(12)  # both joined the coordination barrier by now
-        if procs[1].poll() is not None:
+        if procs[0].poll() is not None or procs[1].poll() is not None:
             # Run already finished (fast box): crash propagation untestable
             # in this configuration — not a failure-detection regression.
             pytest.skip("run completed before the kill could land")

@@ -232,3 +232,68 @@ def test_cli_trace_and_run_report_end_to_end(tmp_path, capsys):
     assert report["occupancy"]["device_batches"] >= 1
     assert report["config"]["backend"] == "tpu"
     assert os.path.getsize(trace_path) > 0
+
+
+# --- cross-host clock alignment ----------------------------------------------
+
+
+def test_align_shifts_subsequent_events_and_records_offset():
+    TRACER.configure(None)
+    TRACER.instant("before_handshake")
+    TRACER.align(2_000_000, args={"origin_wall_us": 123, "backend": "test"})
+    TRACER.instant("after_handshake")
+    with TRACER.span("aligned_span"):
+        pass
+    TRACER.close()
+    events = TRACER.drain()
+    by_name = {e["name"]: e for e in events}
+    # The metadata event documents the offset and the handshake's inputs.
+    meta = by_name["trace_clock_offset"]
+    assert meta["ph"] == "M"
+    assert meta["args"]["offset_us"] == 2_000_000
+    assert meta["args"]["origin_wall_us"] == 123
+    assert meta["args"]["backend"] == "test"
+    # Pre-handshake events keep near-zero ts; post-handshake events sit a
+    # full offset later — several hosts' traces interleave on one timeline.
+    assert by_name["before_handshake"]["ts"] < 1_000_000
+    assert by_name["after_handshake"]["ts"] >= 2_000_000
+    assert by_name["aligned_span"]["ts"] >= 2_000_000
+
+
+def test_align_is_noop_when_disabled():
+    assert not TRACER.enabled
+    TRACER.align(5_000_000)  # must not raise or queue anything
+    assert TRACER.drain() == []
+    TRACER.configure(None)
+    TRACER.instant("tick")
+    TRACER.close()
+    (e,) = [x for x in TRACER.drain() if x["name"] == "tick"]
+    assert e["ts"] < 1_000_000  # the disabled-time align left no offset
+
+
+def test_wall_at_origin_is_recent_wall_clock():
+    import time as _time
+
+    TRACER.configure(None)
+    w = TRACER.wall_at_origin_us()
+    now_us = int(_time.time() * 1e6)
+    # The origin was "when configure() ran": in the past, within seconds.
+    assert 0 <= now_us - w < 5_000_000
+    TRACER.close()
+    TRACER.drain()
+
+
+def test_single_process_alignment_handshake_offsets_zero():
+    # The multihost startup handshake on a 1-process gang: the only host's
+    # origin IS the minimum, so its offset must be exactly zero.
+    from textblaster_tpu.parallel.multihost import _align_trace_clocks
+
+    TRACER.configure(None)
+    _align_trace_clocks()
+    TRACER.close()
+    events = TRACER.drain()
+    meta = [e for e in events if e["name"] == "trace_clock_offset"]
+    assert len(meta) == 1
+    assert meta[0]["args"]["offset_us"] == 0
+    assert "origin_wall_us" in meta[0]["args"]
+    assert meta[0]["args"]["host_walls_us"] == [meta[0]["args"]["origin_wall_us"]]

@@ -57,7 +57,13 @@ from .utils.metrics import METRICS
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["CheckpointState", "run_checkpointed", "CHECKPOINT_FILE"]
+__all__ = [
+    "CheckpointState",
+    "run_checkpointed",
+    "run_stripe_checkpointed",
+    "StripeLost",
+    "CHECKPOINT_FILE",
+]
 
 CHECKPOINT_FILE = "checkpoint.json"
 _VERSION = 1
@@ -116,6 +122,12 @@ class CheckpointState:
     # and fails fast on mismatch.  Absent in pre-geometry cursors (None), so
     # the default keeps old cursors loadable.
     geometry: Optional[dict] = None
+    # Elastic-membership owner token ({"rank", "incarnation"}) for per-rank
+    # stripe cursors (parallel/multihost.py --elastic): the process named
+    # here is the only one allowed to advance the cursor, and adoption
+    # rewrites it (:meth:`adopt`).  Absent in single-host cursors (None), so
+    # the default keeps old cursors loadable.
+    owner: Optional[dict] = None
     version: int = _VERSION
 
     def save(
@@ -161,6 +173,49 @@ class CheckpointState:
                 f"checkpoint version {d.get('version')} is not supported"
             )
         return cls(**d)
+
+    @classmethod
+    def adopt(
+        cls,
+        ckpt_dir: str,
+        owner: dict,
+        *,
+        input_fingerprint: dict,
+        config_hash: str,
+        retry_policy: Optional["RetryPolicy"] = None,
+    ) -> "CheckpointState":
+        """Claim (or create) a stripe cursor for ``owner`` and commit it.
+
+        The elastic-membership claim point (``--elastic``): a process takes
+        over a stripe — its own on a fresh start or rejoin, an evicted
+        peer's on adoption — by rewriting the cursor's ``owner`` token.
+        Work committed by the previous owner (``rows_consumed``, parts,
+        counts) is kept verbatim, so the new owner resumes at the next
+        chunk, replaying nothing.  Fingerprints are validated exactly like
+        a single-host resume; a mismatch means the directory belongs to a
+        different input or config and the caller must remove it.
+        """
+        FAULTS.fire("multihost.rejoin")
+        state = cls.load(ckpt_dir)
+        if state is None:
+            state = cls(input=input_fingerprint, config_hash=config_hash)
+        else:
+            if state.input != input_fingerprint:
+                raise CheckpointError(
+                    f"stripe cursor in '{ckpt_dir}' was created for a "
+                    f"different input ({state.input.get('path')}, "
+                    f"{state.input.get('num_rows')} rows); remove the "
+                    "membership directory to start over"
+                )
+            if state.config_hash != config_hash:
+                raise CheckpointError(
+                    f"stripe cursor in '{ckpt_dir}' was created with a "
+                    "different pipeline config; remove the membership "
+                    "directory to start over"
+                )
+        state.owner = dict(owner)
+        state.save(ckpt_dir, retry_policy)
+        return state
 
 
 class _PartWriter:
@@ -234,6 +289,143 @@ def _concat_parts(
                 writer.write_table(table.cast(schema))
     finally:
         writer.close()
+
+
+class StripeLost(Exception):
+    """Control-flow signal for the elastic stripe loop: the stripe this
+    process was advancing no longer belongs to it (its preferred owner
+    rejoined, or another rank's claim landed first).  Raised by the caller's
+    ``fence`` callback inside :func:`run_stripe_checkpointed`; the chunk in
+    flight is discarded (never committed) and the function returns
+    ``False`` so the caller can move on.  Deliberately NOT a
+    :class:`~textblaster_tpu.errors.PipelineError`: nothing failed."""
+
+
+def run_stripe_checkpointed(
+    input_file: str,
+    ckpt_dir: str,
+    *,
+    state: CheckpointState,
+    skip_rows: int,
+    take_rows: int,
+    chunk_size: int,
+    process_chunk: Callable[[Iterator, Callable], Iterator[ProcessingOutcome]],
+    fence: Optional[Callable[[], None]] = None,
+    lineage: str = "",
+    text_column: str = "text",
+    id_column: str = "id",
+    record_dead: bool = False,
+    retry_policy: Optional[RetryPolicy] = None,
+    on_chunk: Optional[Callable[[CheckpointState], None]] = None,
+) -> bool:
+    """Advance one input stripe's cursor chunk by chunk (``--elastic``).
+
+    The stripe is the row window ``[skip_rows, skip_rows + take_rows)`` of
+    ``input_file``; ``state`` is its (already adopted, fingerprint-verified)
+    cursor.  Each iteration reads one chunk past ``state.rows_consumed``,
+    runs ``process_chunk(items, on_read_error)``, commits the kept/excluded
+    (and, with ``record_dead``, dead-letter) part files, then the cursor —
+    the same commit discipline as :func:`run_checkpointed`, minus the
+    finalize: parts stay in ``ckpt_dir`` for the run-level merge.
+
+    Two differences carry the elastic-membership semantics:
+
+    * ``fence`` runs before each chunk and again immediately before each
+      cursor commit.  It may raise :class:`StripeLost` (ownership moved —
+      the in-flight chunk is discarded, never committed, and the function
+      returns ``False``) or any error (propagated; a self-fenced process
+      uses this to die rather than double-commit).
+    * ``lineage`` scopes the part-file prefixes (``out{lineage}-NNNNN``)
+      to one (rank, incarnation), so a zombie owner racing its adopter in
+      the lease-TTL window writes to *different* files — the cursor, with
+      its single atomic writer-wins rename, is the only commit point, and
+      an unrecorded part from the loser is a stray file, not corruption.
+
+    Returns ``True`` when the stripe is fully consumed, ``False`` on
+    :class:`StripeLost`.  Counts fold into ``state`` only at commit, so a
+    discarded chunk leaves the cursor's totals exact.
+    """
+    policy = retry_policy or _default_commit_retry()
+    if take_rows - state.rows_consumed <= 0:
+        return True
+
+    out_parts = _PartWriter(ckpt_dir, f"out{lineage}", state.out_parts)
+    excl_parts = _PartWriter(ckpt_dir, f"excl{lineage}", state.excl_parts)
+    dead_rows: List[dict] = []
+    read_errors_box = [0]
+
+    def on_read_error(err) -> None:
+        read_errors_box[0] += 1
+        if record_dead:
+            dead_rows.append(read_error_row(err))
+
+    raw = islice(
+        read_documents(
+            input_file,
+            text_column=text_column,
+            id_column=id_column,
+            batch_size=chunk_size,
+            skip_rows=skip_rows + state.rows_consumed,
+            retry_policy=policy,
+        ),
+        take_rows - state.rows_consumed,
+    )
+    try:
+        while True:
+            if fence is not None:
+                fence()
+            chunk = list(islice(raw, chunk_size))
+            if not chunk:
+                return True
+            counts = {"received": 0, "success": 0, "filtered": 0, "errors": 0}
+            for outcome in process_chunk(iter(chunk), on_read_error):
+                counts["received"] += 1
+                if outcome.kind == ProcessingOutcome.SUCCESS:
+                    counts["success"] += 1
+                    METRICS.inc("producer_results_success_total")
+                    out_parts.append(outcome.document)
+                elif outcome.kind == ProcessingOutcome.FILTERED:
+                    counts["filtered"] += 1
+                    METRICS.inc("producer_results_filtered_total")
+                    excl_parts.append(outcome.document)
+                else:
+                    counts["errors"] += 1
+                    METRICS.inc("producer_results_error_total")
+                    if record_dead:
+                        dead_rows.append(outcome_row(outcome))
+                METRICS.inc("producer_results_received_total")
+
+            if fence is not None:
+                fence()  # self-fence: last check before anything commits
+            out_parts.roll()
+            excl_parts.roll()
+            if dead_rows:
+                name = f"err{lineage}-{len(state.err_parts):05d}.parquet"
+                with DeadLetterSink(os.path.join(ckpt_dir, name)) as sink:
+                    for row in dead_rows:
+                        sink.record_row(row)
+                state.err_parts.append(name)
+            dead_rows.clear()
+            state.rows_consumed += len(chunk)
+            state.read_errors += read_errors_box[0]
+            read_errors_box[0] = 0
+            state.received += counts["received"]
+            state.success += counts["success"]
+            state.filtered += counts["filtered"]
+            state.errors += counts["errors"]
+            state.out_parts = out_parts.parts
+            state.excl_parts = excl_parts.parts
+            state.save(ckpt_dir, policy)
+            if on_chunk is not None:
+                on_chunk(state)
+    except StripeLost:
+        out_parts.abort()
+        excl_parts.abort()
+        return False
+    except BaseException:
+        out_parts.abort()
+        excl_parts.abort()
+        raise
 
 
 def run_checkpointed(

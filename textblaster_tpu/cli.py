@@ -15,12 +15,13 @@ which have no equivalent here.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 
 from . import __version__
 from .config.pipeline import load_pipeline_config
-from .errors import PipelineError
+from .errors import PeerFailure, PipelineError
 from .utils.logging_setup import init_logging
 from .utils.metrics import (
     METRICS,
@@ -150,6 +151,23 @@ def build_parser() -> argparse.ArgumentParser:
                           "leftovers from a previous crashed run instead of "
                           "failing fast when they would be silently ignored "
                           "by the final merge")
+    run.add_argument("--exchange-deadline-s", type=float, default=None,
+                     help="With --coordinator: budget for each lockstep "
+                          "exchange; on expiry the run fails fast with a "
+                          "typed PeerFailure naming the rank(s) that never "
+                          "posted (default 300)")
+    run.add_argument("--lease-ttl-s", type=float, default=None,
+                     help="With --coordinator: liveness-lease TTL, renewed "
+                          "at TTL/3; a rank whose lease is older is "
+                          "classified dead (default 10)")
+    run.add_argument("--elastic", action="store_true",
+                     help="With --coordinator: elastic gang membership — "
+                          "ranks coordinate through shared-filesystem "
+                          "leases and per-stripe checkpoint cursors "
+                          "instead of lockstep collectives; survivors "
+                          "adopt a dead rank's stripe, and a relaunched "
+                          "rank rejoins in place replaying no completed "
+                          "work")
 
     val = sub.add_parser("validate-config",
                          help="Validate a pipeline configuration and exit")
@@ -276,6 +294,25 @@ def _cmd_run(args: argparse.Namespace) -> int:
         print("--coordinator requires the compiled pipeline "
               "(--backend tpu or cpu, not host)", file=sys.stderr)
         return 1
+    if not args.coordinator and (
+        args.elastic
+        or args.exchange_deadline_s is not None
+        or args.lease_ttl_s is not None
+    ):
+        print("--elastic / --exchange-deadline-s / --lease-ttl-s shape the "
+              "multi-host membership layer and require --coordinator",
+              file=sys.stderr)
+        return 1
+    if args.elastic and (args.run_report or args.auto_geometry):
+        print("--elastic is incompatible with --run-report and "
+              "--auto-geometry (both are full-gang collectives)",
+              file=sys.stderr)
+        return 1
+    for name, val in (("--exchange-deadline-s", args.exchange_deadline_s),
+                      ("--lease-ttl-s", args.lease_ttl_s)):
+        if val is not None and val <= 0:
+            print(f"{name} must be positive, got {val}", file=sys.stderr)
+            return 1
     # Entered manually (not a with-block) so the existing dispatch block
     # keeps its indentation; TRACER.close() must run on every path so a
     # failed run still leaves a loadable (truncation-tolerant) trace.
@@ -292,6 +329,12 @@ def _cmd_run(args: argparse.Namespace) -> int:
                 mh_kwargs["device_batch"] = args.device_batch
             if args.auto_geometry:
                 mh_kwargs["auto_geometry"] = True
+            if args.exchange_deadline_s is not None:
+                mh_kwargs["exchange_deadline_s"] = args.exchange_deadline_s
+            if args.lease_ttl_s is not None:
+                mh_kwargs["lease_ttl_s"] = args.lease_ttl_s
+            if args.elastic:
+                mh_kwargs["elastic"] = True
             result = run_multihost(
                 config,
                 args.input_file,
@@ -350,6 +393,17 @@ def _cmd_run(args: argparse.Namespace) -> int:
                 quiet=args.quiet,
                 errors_file=args.errors_file,
             )
+    except PeerFailure as e:
+        # A dead gang member: run_multihost already abandoned the
+        # distributed client, but the coordination service's C++ error
+        # poller races normal interpreter teardown and may SIGABRT us
+        # mid-exit.  Flush the diagnosis and hard-exit deterministically —
+        # there is no graceful path out of a broken gang.
+        print(f"Pipeline run failed: {e}", file=sys.stderr, flush=True)
+        profile_ctx.__exit__(None, None, None)
+        TRACER.close()
+        sys.stdout.flush()
+        os._exit(1)
     except PipelineError as e:
         print(f"Pipeline run failed: {e}", file=sys.stderr)
         return 1
@@ -390,6 +444,18 @@ def _cmd_run(args: argparse.Namespace) -> int:
         print(
             f"Negotiated resilience: {neg_retries} jointly retried rounds, "
             f"{neg_degraded} rounds degraded to the host oracle.",
+            file=sys.stderr,
+        )
+    evictions = int(METRICS.get("multihost_evictions_total"))
+    rejoins = int(METRICS.get("multihost_rejoins_total"))
+    adopted = int(METRICS.get("multihost_adopted_stripes_total"))
+    if evictions or rejoins or adopted:
+        # Membership churn is an operational signal like a degraded round:
+        # the run completed, but not with the gang it started with.
+        print(
+            f"Elastic membership: {evictions} eviction(s), {rejoins} "
+            f"rejoin(s), {adopted} stripe(s) adopted; final epoch "
+            f"{int(METRICS.get('multihost_membership_epoch'))}.",
             file=sys.stderr,
         )
     tripped = int(METRICS.get("resilience_breaker_trips_total"))

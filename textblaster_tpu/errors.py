@@ -27,6 +27,7 @@ __all__ = [
     "UnexpectedError",
     "CheckpointError",
     "RetryExhaustedError",
+    "PeerFailure",
 ]
 
 
@@ -117,6 +118,38 @@ class CheckpointError(PipelineError):
 
     def __str__(self) -> str:
         return f"Checkpoint error: {self.args[0] if self.args else ''}"
+
+
+class PeerFailure(PipelineError):
+    """A multi-host exchange could not complete because of peer processes
+    (no reference equivalent — the reference's workers are independent).
+
+    Raised instead of hanging when a lockstep exchange's deadline expires
+    (a peer never posted its row) or a peer posts malformed data.  Carries
+    the exchange coordinates (``seq``, ``epoch``) and the rank lists so
+    operators and supervisors can act on *which* process failed:
+    ``dead_ranks`` are peers whose liveness lease had already expired when
+    the deadline hit; ``missing_ranks`` are all peers that never posted
+    (dead or merely slow).
+    """
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        missing_ranks=(),
+        dead_ranks=(),
+        seq: Optional[int] = None,
+        epoch: Optional[int] = None,
+    ) -> None:
+        super().__init__(message)
+        self.missing_ranks = tuple(missing_ranks)
+        self.dead_ranks = tuple(dead_ranks)
+        self.seq = seq
+        self.epoch = epoch
+
+    def __str__(self) -> str:
+        return f"Peer failure: {self.args[0] if self.args else ''}"
 
 
 class RetryExhaustedError(PipelineError):

@@ -35,13 +35,26 @@ faults no longer kill the job; per-host dead-letter shards merge like
 kept/excluded; and the host-0 merge commits every final atomically
 (tmp + fsync + rename via :func:`merge_shard_files`), deleting shards only
 after every rename lands.
+
+Elastic membership (PR 6): every KV exchange is deadline-bounded
+(``--exchange-deadline-s``) and raises a typed
+:class:`~textblaster_tpu.errors.PeerFailure` naming the unposted ranks —
+dead-versus-slow resolved against renewable KV liveness leases
+(``--lease-ttl-s``) — instead of blocking on the old hardcoded 300 s get;
+exchange keys are namespaced by epoch and deleted once drained.  With
+``--elastic`` the run leaves the lockstep contract entirely
+(:func:`_run_elastic`): membership lives in shared-filesystem leases,
+survivors adopt a dead rank's input stripe at the membership-epoch bump,
+and a SIGKILLed rank can be relaunched to rejoin in place from its
+committed cursor — replaying zero completed chunks, outcomes
+byte-identical to a fault-free run.
 """
 
 from __future__ import annotations
 
-import itertools
 import json
 import math
+import time
 from typing import Iterable, List, Optional, Sequence, Tuple
 
 import jax
@@ -49,7 +62,15 @@ import numpy as np
 
 from ..config.pipeline import PipelineConfig
 from ..data_model import ProcessingOutcome, TextDocument
+from ..errors import PeerFailure
 from ..ops.packing import pack_documents
+from ..resilience.membership import (
+    DEFAULT_EXCHANGE_DEADLINE_S,
+    DEFAULT_LEASE_TTL_S,
+    KVLeaseStore,
+    LeaseHeartbeat,
+    _kv_set,
+)
 from ..utils.trace import TRACER
 from .mesh import DATA_AXIS, batch_sharding
 
@@ -57,6 +78,10 @@ __all__ = [
     "initialize",
     "global_data_mesh",
     "host_allgather",
+    "configure_exchange",
+    "bump_exchange_epoch",
+    "current_exchange_epoch",
+    "PeerFailure",
     "detect_stale_shards",
     "merge_shard_files",
     "run_local_shard",
@@ -115,6 +140,39 @@ def _commit_merged(final: str, shards: Sequence[str]) -> None:
     finally:
         if writer is not None:
             writer.close()
+    fd = os.open(tmp, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    os.replace(tmp, final)
+    dfd = os.open(os.path.dirname(os.path.abspath(final)), os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
+    METRICS.inc("multihost_merge_commits_total")
+
+
+def _commit_concat(final: str, part_paths: Sequence[str], schema) -> None:
+    """Concatenate Parquet parts into ``final`` atomically, with an
+    **explicit schema**: unlike :func:`_commit_merged` (which infers the
+    schema from the first shard), zero parts still commit a well-formed
+    empty file — the elastic merge must produce valid finals even when
+    every row was filtered or a stripe is empty."""
+    import os
+
+    import pyarrow.parquet as pq
+
+    from ..utils.metrics import METRICS
+
+    tmp = final + ".tmp"
+    writer = pq.ParquetWriter(tmp, schema)
+    try:
+        for p in part_paths:
+            writer.write_table(pq.read_table(p).cast(schema))
+    finally:
+        writer.close()
     fd = os.open(tmp, os.O_RDONLY)
     try:
         os.fsync(fd)
@@ -199,7 +257,154 @@ def global_data_mesh() -> "jax.sharding.Mesh":
     return Mesh(np.array(devices), (DATA_AXIS,))
 
 
-_AG_SEQ = itertools.count()
+class _ExchangeState:
+    """Shared round state for the KV-transport lockstep exchanges.
+
+    The old implementation keyed each exchange by a process-local
+    ``itertools.count`` — fine while every process lives forever, but a
+    relaunched process restarts its counter at 0 and can never re-enter.
+    Keys are now namespaced by an **exchange epoch** with the sequence
+    number restarting at every epoch boundary, and the epoch advances only
+    at points derived from shared round state (:func:`bump_exchange_epoch`
+    at each negotiated phase boundary in :func:`run_local_shard`), so any
+    process that re-enters at an epoch boundary computes the same key names
+    as its peers.  Drained epochs are deleted (see :func:`host_allgather`'s
+    hygiene note), so the KV store holds O(1) allgather keys per rank
+    instead of growing for the life of the coordinator.
+    """
+
+    def __init__(self) -> None:
+        self.deadline_s: float = DEFAULT_EXCHANGE_DEADLINE_S
+        self.epoch: int = 0
+        self.seq: int = 0
+        self.lease_store: Optional[KVLeaseStore] = None
+        # Own (epoch, seq) keys whose epoch drained but whose read-proof
+        # (a peer completing a later exchange) hadn't landed yet.
+        self.pending_delete: List[Tuple[int, int]] = []
+
+
+_EXCHANGE = _ExchangeState()
+
+#: Timeout for the post-deadline sweep that names EVERY laggard (not just
+#: the first): once the budget is spent, each remaining rank gets one short
+#: probe instead of the full deadline again.
+_PROBE_TIMEOUT_MS = 1000
+
+
+def configure_exchange(
+    deadline_s: Optional[float] = None,
+    lease_store: Optional[KVLeaseStore] = None,
+    reset: bool = True,
+) -> None:
+    """Configure the exchange deadline / lease table for this process and
+    (by default) restart the epoch/sequence counters — called by
+    :func:`run_multihost` on every process at run start, so the shared
+    round state begins aligned."""
+    if deadline_s is not None:
+        _EXCHANGE.deadline_s = float(deadline_s)
+    _EXCHANGE.lease_store = lease_store
+    if reset:
+        _EXCHANGE.epoch = 0
+        _EXCHANGE.seq = 0
+        _EXCHANGE.pending_delete = []
+
+
+def current_exchange_epoch() -> int:
+    """The epoch namespace current exchanges are keyed under (trace/metrics
+    labeling; every process in lockstep reports the same value)."""
+    return _EXCHANGE.epoch
+
+
+def bump_exchange_epoch() -> int:
+    """Open the next exchange epoch: the sequence restarts at 0 and the
+    drained epoch's last own key is queued for deletion (it is removed once
+    a completed exchange in the new epoch proves every peer has read it).
+    Must be called in lockstep — :func:`run_local_shard` does so at every
+    negotiated phase boundary, the shared round state all processes agree
+    on without communicating."""
+    if _EXCHANGE.seq > 0:
+        _EXCHANGE.pending_delete.append((_EXCHANGE.epoch, _EXCHANGE.seq - 1))
+    _EXCHANGE.epoch += 1
+    _EXCHANGE.seq = 0
+    return _EXCHANGE.epoch
+
+
+def _ag_key(epoch: int, seq: int, rank: int) -> str:
+    return f"textblast/allgather/e{epoch}/s{seq}/{rank}"
+
+
+def _validate_rows(
+    rows: Sequence[Sequence[int]], width: int, *, seq: int, epoch: int
+) -> None:
+    """Ragged-row guard: every peer's row must match this process's lane
+    count.  A shorter/empty row previously fed a ragged list-of-lists to
+    ``np.asarray`` (an object-dtype array that crashed far from the cause);
+    now the offending rank is named in a typed :exc:`PeerFailure`."""
+    for r, row in enumerate(rows):
+        if len(row) != width:
+            from ..utils.metrics import METRICS
+
+            METRICS.inc("multihost_peer_failures_total")
+            raise PeerFailure(
+                f"exchange e{epoch}/s{seq}: rank {r} posted {len(row)} "
+                f"lane(s) where {width} were expected — a desynchronized "
+                "or corrupted peer (ragged allgather row)",
+                missing_ranks=(r,),
+                seq=seq,
+                epoch=epoch,
+            )
+
+
+def _raise_peer_failure(
+    missing: Sequence[int],
+    *,
+    seq: int,
+    epoch: int,
+    deadline_s: float,
+    transport_error: str = "",
+) -> None:
+    """Deadline expired with peers unposted: resolve dead-vs-slow against
+    the lease table and raise the typed error naming both lists.
+    ``transport_error`` carries the coordination service's own words (a
+    heartbeat/UNAVAILABLE teardown reads very differently from a plain
+    DEADLINE_EXCEEDED, and operators grep for it)."""
+    from ..utils.metrics import METRICS
+
+    dead: List[int] = []
+    store = _EXCHANGE.lease_store
+    if store is not None:
+        try:
+            dead, _slow = store.resolve_liveness(missing)
+        except Exception:  # pragma: no cover - lease table best-effort
+            dead = []
+    METRICS.inc("multihost_peer_failures_total")
+    TRACER.instant(
+        "peer_failure",
+        {"seq": seq, "epoch": epoch, "missing": list(missing),
+         "dead": list(dead)},
+    )
+    detail = (
+        f"; liveness leases mark rank(s) {list(dead)} dead "
+        f"(lease older than {store.ttl_s:g}s)"
+        if dead and store is not None
+        else "; every missing rank still holds a fresh liveness lease "
+        "(slow or wedged, not dead)"
+        if store is not None
+        else ""
+    )
+    transport = (
+        f"; last transport error: {transport_error[:300]}"
+        if transport_error
+        else ""
+    )
+    raise PeerFailure(
+        f"exchange e{epoch}/s{seq} deadline ({deadline_s:g}s) expired; "
+        f"rank(s) {list(missing)} never posted{detail}{transport}",
+        missing_ranks=missing,
+        dead_ranks=dead,
+        seq=seq,
+        epoch=epoch,
+    )
 
 
 def host_allgather(vec: np.ndarray) -> np.ndarray:
@@ -212,9 +417,28 @@ def host_allgather(vec: np.ndarray) -> np.ndarray:
     same exchange rides the ``jax.distributed`` coordination-service
     key-value store, the transport that already carries barriers and
     heartbeats.  Callers must invoke it in lockstep (the contract this
-    module enforces anyway): a per-process sequence number keys each
-    exchange, and the blocking gets double as the barrier — no process
-    proceeds until every peer has posted its row."""
+    module enforces anyway): keys are ``(epoch, seq, rank)`` tuples from the
+    shared round state (:class:`_ExchangeState`), and the blocking gets
+    double as the barrier — no process proceeds until every peer has posted
+    its row.
+
+    KV-path failure semantics (the exchange *deadline*, PR 6): the whole
+    exchange gets ``configure_exchange``'s budget (default
+    ``DEFAULT_EXCHANGE_DEADLINE_S``; ``--exchange-deadline-s``) instead of
+    the old hardcoded 300 s per rank.  On expiry, the remaining ranks are
+    each probed briefly so every laggard is identified, peer liveness is
+    resolved against the KV lease table, and a typed :exc:`PeerFailure`
+    names the exchange coordinates, the missing ranks, and which of them
+    hold expired leases (dead) versus fresh ones (slow).  Rows are also
+    validated for raggedness (:func:`_validate_rows`).  The accelerator
+    path is XLA's collective and carries no host-side deadline — there the
+    coordination-service heartbeat teardown remains the backstop.
+
+    Hygiene: completing exchange ``s`` proves every peer has read exchange
+    ``s-1`` (each peer posts ``s`` only after fully reading ``s-1``), so
+    this process's ``s-1`` key — and any queued keys from drained epochs —
+    are deleted after each completed exchange.  The KV table stays O(1) per
+    rank for the life of the coordinator."""
     arr = np.asarray(vec, dtype=np.int64).ravel()
     n = jax.process_count()
     if n == 1:
@@ -228,17 +452,51 @@ def host_allgather(vec: np.ndarray) -> np.ndarray:
     from jax._src import distributed
 
     client = distributed.global_state.client
-    seq = next(_AG_SEQ)
-    client.key_value_set(
-        f"textblast/allgather/{seq}/{jax.process_index()}",
+    me = jax.process_index()
+    epoch, seq = _EXCHANGE.epoch, _EXCHANGE.seq
+    _EXCHANGE.seq += 1
+    _kv_set(
+        client,
+        _ag_key(epoch, seq, me),
         ",".join(str(int(x)) for x in arr),
     )
-    rows = []
+    deadline_s = _EXCHANGE.deadline_s
+    t0 = time.monotonic()
+    own_row = [int(x) for x in arr]
+    rows: List[List[int]] = []
+    missing: List[int] = []
+    transport_error = ""
     for r in range(n):
-        raw = client.blocking_key_value_get(
-            f"textblast/allgather/{seq}/{r}", 300_000
-        )
+        if r == me:
+            rows.append(own_row)
+            continue
+        remaining_ms = int((deadline_s - (time.monotonic() - t0)) * 1000)
+        timeout_ms = remaining_ms if remaining_ms > 0 else _PROBE_TIMEOUT_MS
+        try:
+            raw = client.blocking_key_value_get(
+                _ag_key(epoch, seq, r), timeout_ms
+            )
+        except Exception as e:  # DEADLINE_EXCEEDED / service teardown
+            missing.append(r)
+            rows.append([])
+            transport_error = str(e)
+            continue
         rows.append([int(x) for x in raw.split(",")] if raw else [])
+    if missing:
+        _raise_peer_failure(
+            missing, seq=seq, epoch=epoch, deadline_s=deadline_s,
+            transport_error=transport_error,
+        )
+    _validate_rows(rows, len(own_row), seq=seq, epoch=epoch)
+    drained = [_ag_key(e, s, me) for e, s in _EXCHANGE.pending_delete]
+    _EXCHANGE.pending_delete.clear()
+    if seq > 0:
+        drained.append(_ag_key(epoch, seq - 1, me))
+    for key in drained:
+        try:
+            client.key_value_delete(key)
+        except Exception:  # pragma: no cover - hygiene is best-effort
+            pass
     return np.asarray(rows, dtype=np.int64)
 
 
@@ -296,6 +554,35 @@ def _negotiate_max(needed_local: np.ndarray) -> np.ndarray:
     job until the coordinator heartbeat tears it down.  One small allgather
     makes the schedule global and deterministic."""
     return host_allgather(needed_local).max(axis=0).astype(np.int32)
+
+
+def _align_trace_clocks() -> None:
+    """Cross-host trace clock handshake (one allgather at run start).
+
+    Each process's tracer stamps events from a private ``perf_counter``
+    origin, so per-host trace files loaded into one Perfetto session show
+    hosts skewed by their process start times.  Every process allgathers
+    the wall-clock time of its tracer origin; the **minimum** becomes the
+    run's shared origin and each tracer shifts its timestamps by
+    ``own_wall - min_wall`` (recording the offset and every host's wall in
+    a ``trace_clock_offset`` metadata event).  The exchange is
+    unconditional — it is a collective, and a host without ``--trace``
+    still must participate or the gang desynchronizes; only the local
+    ``align`` is gated on tracing being enabled.  Alignment is as good as
+    the hosts' wall clocks (NTP-grade), which is what a cross-host
+    timeline needs — spans are still *timed* by each host's monotonic
+    clock."""
+    wall = TRACER.wall_at_origin_us()
+    walls = host_allgather(np.array([wall], dtype=np.int64))[:, 0]
+    if TRACER.enabled:
+        origin = int(walls.min())
+        TRACER.align(
+            wall - origin,
+            args={
+                "origin_wall_us": origin,
+                "host_walls_us": [int(w) for w in walls],
+            },
+        )
 
 
 def run_local_shard(
@@ -445,6 +732,12 @@ def run_local_shard(
     outcomes: List[ProcessingOutcome] = []
     n_phases = len(pipeline.phases)
     for phase in range(n_phases):
+        # Exchange epochs advance with the negotiated phase sequence — a
+        # piece of round state every process derives identically without
+        # communicating (phases are negotiated in lockstep), which is what
+        # lets KV exchange keys be namespaced deterministically instead of
+        # by a process-local counter (see _ExchangeState).
+        bump_exchange_epoch()
         needed_local = np.array(
             [math.ceil(len(current[b]) / local_for[b]) for b in buckets],
             dtype=np.int32,
@@ -532,6 +825,9 @@ def run_multihost(
     force: bool = False,
     run_report: Optional[str] = None,
     provenance: Optional[dict] = None,
+    exchange_deadline_s: float = DEFAULT_EXCHANGE_DEADLINE_S,
+    lease_ttl_s: float = DEFAULT_LEASE_TTL_S,
+    elastic: bool = False,
 ):
     """Production multi-host entry (``textblast run --coordinator ...``).
 
@@ -559,17 +855,32 @@ def run_multihost(
     merge), local totals elsewhere.
 
     Failure behavior (measured, tests/test_multihost.py +
-    tests/test_multihost_chaos.py): a *retryable device fault* on any host
-    no longer kills the job — ``run_local_shard``'s negotiated guard retries
-    the round jointly on every host and, past the budget, degrades it to the
-    host oracle jointly (outcomes stay byte-identical).  If a process *dies*
-    mid-run, survivors do NOT hang on the next allgather — the jax
-    coordination service detects the missed heartbeats (~90 s) and
-    propagates UNAVAILABLE to every healthy task, which exits nonzero with
-    the dead task named in the error.  The run is then re-launched whole;
-    per-process restart-in-place is not supported (matches the reference's
-    worker model, where a dead worker's unacked queue messages are simply
-    redelivered to a fresh worker).
+    tests/test_multihost_chaos.py + tests/test_elastic_membership.py): a
+    *retryable device fault* on any host no longer kills the job —
+    ``run_local_shard``'s negotiated guard retries the round jointly on
+    every host and, past the budget, degrades it to the host oracle jointly
+    (outcomes stay byte-identical).  If a process *dies* mid-run, survivors
+    do not wait forever on the next exchange: every KV-transport allgather
+    is bounded by ``exchange_deadline_s`` and on expiry raises a typed
+    :exc:`PeerFailure` naming the exchange coordinates and every rank that
+    never posted, with dead-versus-slow resolved against the renewable KV
+    liveness leases each process maintains (TTL ``lease_ttl_s``, renewed by
+    a daemon heartbeat at TTL/3).  The accelerator collective path carries
+    no host-side deadline — there, and for deadlines configured beyond it,
+    the jax coordination-service heartbeat teardown (~90 s, UNAVAILABLE to
+    every healthy task) remains the backstop.  After a ``PeerFailure`` the
+    lockstep run is re-launched whole — the lockstep contract cannot
+    reshape a live gang.
+
+    ``elastic=True`` trades the lockstep contract for membership that can
+    shrink, grow, and restart in place (:func:`_run_elastic`): processes
+    coordinate through renewable leases and per-stripe checkpoint cursors
+    on the shared filesystem instead of ``jax.distributed`` collectives,
+    survivors adopt a dead rank's stripe at the membership-epoch bump, and
+    a relaunched rank rejoins mid-run resuming from the committed cursor —
+    replaying zero completed chunks, with outcomes byte-identical to a
+    fault-free run.  Incompatible with ``run_report``/``auto_geometry``
+    (both are defined in terms of full-gang collectives).
     """
     import os
     from itertools import islice
@@ -613,6 +924,31 @@ def run_multihost(
             else:
                 METRICS.inc("multihost_stale_shards_removed_total")
 
+    if elastic:
+        if run_report is not None or auto_geometry:
+            raise PipelineError(
+                "--elastic is incompatible with --run-report and "
+                "--auto-geometry: both are full-gang collectives, and "
+                "elastic membership deliberately has no lockstep exchanges "
+                "to carry them"
+            )
+        return _run_elastic(
+            config,
+            input_file,
+            output_file,
+            excluded_file,
+            num_processes=num_processes,
+            process_id=process_id,
+            text_column=text_column,
+            id_column=id_column,
+            buckets=buckets,
+            read_batch_size=read_batch_size,
+            device_batch=device_batch,
+            errors_file=errors_file,
+            lease_ttl_s=lease_ttl_s,
+            force=force,
+        )
+
     initialize(coordinator, num_processes, process_id)
     if jax.process_count() != num_processes:
         # Without this, a topology mismatch (typically jax.distributed
@@ -626,159 +962,559 @@ def run_multihost(
             "jax.distributed initialization cannot be re-shaped"
         )
     arm_from_env(process_id=process_id)
-    mesh = global_data_mesh()
+    configure_exchange(deadline_s=exchange_deadline_s)
+    heartbeat = None
+    if jax.process_count() > 1 and _distributed_initialized():
+        # Liveness leases ride the same coordination-service KV store the
+        # exchanges do, so an expired exchange deadline can tell the user
+        # WHICH missing ranks are dead (lease expired) vs merely slow.
+        from jax._src import distributed
 
-    import time as _time
+        client = getattr(distributed.global_state, "client", None)
+        if client is not None:
+            store = KVLeaseStore(client, process_id, lease_ttl_s)
+            store.post()
+            heartbeat = LeaseHeartbeat(
+                store, max(0.05, lease_ttl_s / 3.0)
+            )
+            heartbeat.start()
+            configure_exchange(
+                deadline_s=exchange_deadline_s,
+                lease_store=store,
+                reset=False,
+            )
+    try:
+        mesh = global_data_mesh()
+        _align_trace_clocks()
 
-    # Run-report scope starts here: everything after distributed init is
-    # this run's work, so the snapshot deltas attribute only it.
-    values_before = metrics_snapshot() if run_report is not None else {}
-    wall_t0 = _time.perf_counter()
+        import time as _time
+
+        # Run-report scope starts here: everything after distributed init is
+        # this run's work, so the snapshot deltas attribute only it.
+        values_before = metrics_snapshot() if run_report is not None else {}
+        wall_t0 = _time.perf_counter()
+
+        n_rows = pq.ParquetFile(input_file).metadata.num_rows
+        stride = math.ceil(n_rows / max(num_processes, 1))
+        skip = min(process_id * stride, n_rows)
+        take = max(0, min(stride, n_rows - skip))
+
+        # Per-host dead-letter shard, merged by process 0 exactly like
+        # kept/excluded.  Created eagerly (DeadLetterSink writes the empty
+        # file up front) so the merge never races a host that recorded
+        # nothing.
+        deadletter = (
+            DeadLetterSink(f"{errors_file}.shard{process_id}")
+            if errors_file is not None
+            else None
+        )
+
+        read_errors = 0
+        docs: List[TextDocument] = []
+        stream = read_documents(
+            input_file,
+            text_column=text_column,
+            id_column=id_column,
+            batch_size=read_batch_size,
+            skip_rows=skip,
+        )
+        for item in islice(stream, take):  # one stream item per Parquet row
+            if isinstance(item, PipelineError):
+                read_errors += 1
+                if deadletter is not None:
+                    deadletter.record_read_error(item)
+            else:
+                docs.append(item)
+
+        from ..ops.pipeline import CompiledPipeline
+
+        geometry = None
+        if auto_geometry:
+            # Geometry negotiation: each host histograms ITS shard's
+            # document lengths over the fixed shape-stable bin edges, the
+            # histograms are allgathered and summed elementwise, and every
+            # host derives the geometry from the identical merged histogram
+            # — so the lockstep round schedule (which depends on buckets
+            # and batch sizes) stays in agreement without shipping raw
+            # lengths across hosts.
+            from ..ops.geometry import (
+                geometry_from_histogram,
+                length_histogram,
+            )
+
+            hist = length_histogram([len(d.content) for d in docs])
+            hist = host_allgather(hist).sum(axis=0)
+            if hist.sum() > 0:
+                geometry = geometry_from_histogram(
+                    hist, backend=jax.default_backend()
+                )
+
+        pipeline = CompiledPipeline(
+            config, buckets=tuple(sorted(buckets)), batch_size=device_batch,
+            mesh=mesh, geometry=geometry,
+        )
+        try:
+            outcomes = run_local_shard(
+                config, docs, buckets=pipeline.geometry.buckets, mesh=mesh,
+                pipeline=pipeline,
+            )
+
+            shard_out = f"{output_file}.shard{process_id}"
+            shard_exc = f"{excluded_file}.shard{process_id}"
+            result = aggregate_results_from_stream(
+                iter(outcomes), shard_out, shard_exc, deadletter=deadletter
+            )
+        finally:
+            # The shard must be complete on disk before the totals barrier
+            # releases process 0 into the merge.
+            if deadletter is not None:
+                deadletter.close()
+        result.read_errors = read_errors
+
+        totals = np.array(
+            [result.received, result.success, result.filtered,
+             result.errors, result.read_errors],
+            dtype=np.int64,
+        )
+        # Barrier doubling as the totals exchange: every process must have
+        # closed its shard files before process 0 merges (host_allgather's
+        # blocking gets release only once every peer has posted).
+        all_totals = host_allgather(totals).reshape(-1, 5)
+
+        # Cross-host metrics aggregation: one more lockstep exchange
+        # carrying each process's metrics-delta snapshot (a few KiB of
+        # JSON), so host 0's report survives the other processes' exit.
+        # Runs on EVERY process or on none — see the docstring contract.
+        host_reports = None
+        if run_report is not None:
+            now = metrics_snapshot()
+            local_delta = {
+                k: round(now.get(k, 0.0) - values_before.get(k, 0.0), 6)
+                for k in set(now) | set(values_before)
+                if now.get(k, 0.0) != values_before.get(k, 0.0)
+            }
+            host_reports = host_allgather_obj(
+                {
+                    "process": process_id,
+                    "wall_time_s": round(
+                        _time.perf_counter() - wall_t0, 3
+                    ),
+                    "counts": {
+                        "received": result.received,
+                        "success": result.success,
+                        "filtered": result.filtered,
+                        "errors": result.errors,
+                        "read_errors": result.read_errors,
+                    },
+                    "metrics": local_delta,
+                }
+            )
+
+        if process_id == 0:
+            merge_shard_files(
+                [
+                    (
+                        final,
+                        [f"{final}.shard{i}" for i in range(num_processes)],
+                    )
+                    for final in finals
+                ]
+            )
+            g = all_totals.sum(axis=0)
+            merged = AggregationResult()
+            merged.received, merged.success, merged.filtered = (
+                int(g[0]), int(g[1]), int(g[2])
+            )
+            merged.errors, merged.read_errors = int(g[3]), int(g[4])
+            if host_reports is not None:
+                summed: dict = {}
+                for h in host_reports:
+                    for k, v in h["metrics"].items():
+                        summed[k] = summed.get(k, 0.0) + v
+                report = build_run_report(
+                    values=summed,
+                    wall_time_s=max(
+                        h["wall_time_s"] for h in host_reports
+                    ),
+                    counts={
+                        "received": merged.received,
+                        "success": merged.success,
+                        "filtered": merged.filtered,
+                        "errors": merged.errors,
+                        "read_errors": merged.read_errors,
+                    },
+                    provenance=provenance,
+                    hosts=host_reports,
+                )
+                write_run_report(run_report, report)
+            return merged
+        return result
+    except PeerFailure:
+        # A peer is gone: the coordination service's shutdown barrier can
+        # never complete, and jax's atexit hook would hold this process
+        # hostage until the service's own heartbeat teardown (~95 s on this
+        # stack).  Abandon the distributed client so the survivor's exit is
+        # as fast as its diagnosis.
+        _abandon_distributed()
+        raise
+    finally:
+        if heartbeat is not None:
+            heartbeat.stop()
+
+
+def _abandon_distributed() -> None:
+    """Drop the ``jax.distributed`` client without the shutdown barrier.
+
+    ``DistributedRuntimeClient.shutdown()`` is a full-gang barrier — with a
+    dead rank it blocks until the coordination service force-terminates the
+    survivors.  After a :class:`PeerFailure` the gang is known-broken, so
+    the only useful exit is a non-graceful one: null the client reference
+    (jax's atexit ``clean_up`` then skips the barrier) and leave the
+    service (if this host runs it) to die with the process."""
+    try:
+        from jax._src import distributed
+
+        distributed.global_state.client = None
+        distributed.global_state.preemption_sync_manager = None
+    except Exception as e:  # pragma: no cover - jax internals moved
+        import sys
+
+        print(
+            f"warning: could not abandon distributed client ({e}); exit may "
+            "stall until the coordination service tears the gang down",
+            file=sys.stderr,
+            flush=True,
+        )
+
+
+def _run_elastic(
+    config: PipelineConfig,
+    input_file: str,
+    output_file: str,
+    excluded_file: str,
+    *,
+    num_processes: int,
+    process_id: int,
+    text_column: str,
+    id_column: str,
+    buckets: Sequence[int],
+    read_batch_size: int,
+    device_batch: Optional[int],
+    errors_file: Optional[str],
+    lease_ttl_s: float,
+    force: bool,
+):
+    """Elastic membership execution (``--elastic``) — no lockstep, no gang.
+
+    Processes are deliberately NOT coupled through ``jax.distributed``:
+    on this container's jax the coordination service force-terminates every
+    healthy task ~90-100 s after a peer stops heartbeating, which is the
+    opposite of elasticity.  Coordination instead lives entirely on the
+    shared filesystem under ``<output>.membership/`` (the same filesystem
+    the shard merge already assumes): per-rank lease files
+    (:class:`FileMembershipStore`), and one checkpoint directory per input
+    *stripe* with a fenced, owner-tokened cursor
+    (:func:`~textblaster_tpu.checkpoint.run_stripe_checkpointed`).
+    ``--coordinator`` is accepted but unused.
+
+    The protocol, per heartbeat interval:
+
+    1. **Self-fence** — a process whose own lease went stale (or was taken
+       over by a newer incarnation of its rank) stops committing and dies;
+       its last unfenced commit races the adopter only within the lease
+       TTL, and lineage-scoped part files + the single atomic cursor
+       rename make any interleaving converge (worst case: one chunk is
+       reprocessed, committed once).
+    2. **Observe membership** — live set changes bump the membership epoch
+       (:class:`EpochTracker`), printing eviction/rejoin transitions.
+    3. **Own and advance stripes** — stripe ``s`` belongs to live rank
+       ``s``, orphans to the lowest live rank (:func:`stripe_owner`).
+       Claiming rewrites the cursor's owner token
+       (:meth:`CheckpointState.adopt`); committed work transfers verbatim,
+       so adoption and restart-in-place replay **zero completed chunks**.
+       A relaunched rank simply re-registers a lease under a fresh
+       incarnation and reclaims its cursor; its zombie predecessor (if
+       any) loses ownership at its next fence.
+    4. **Merge** — when every stripe's cursor shows its window consumed,
+       the lowest live rank (merge duty fails over exactly like stripe
+       ownership) concatenates all stripes' part files — in stripe order,
+       so output order is independent of which ranks did the work — into
+       the final kept/excluded (and dead-letter) files atomically with an
+       explicit schema (:func:`_commit_concat`), then removes the
+       membership directory.
+
+    Byte parity: chunk boundaries are device-batch flush barriers and the
+    stripe windows are the same contiguous row ranges the lockstep path
+    uses, so outputs are byte-identical to an uninterrupted (or
+    single-host) run regardless of kills, adoptions, or rejoins.
+
+    Returns an ``AggregationResult``: global totals on the merging rank,
+    this rank's local contribution elsewhere.
+    """
+    import os
+    import shutil
+
+    from ..checkpoint import (
+        CheckpointState,
+        StripeLost,
+        _config_fingerprint,
+        _input_fingerprint,
+        run_stripe_checkpointed,
+    )
+    from ..errors import PipelineError
+    from ..io.parquet_writer import OUTPUT_SCHEMA
+    from ..ops.geometry import DeviceGeometry
+    from ..ops.pipeline import CompiledPipeline, process_documents_device
+    from ..orchestration import AggregationResult
+    from ..resilience.deadletter import DEADLETTER_SCHEMA
+    from ..resilience.faults import arm_from_env
+    from ..resilience.membership import EpochTracker, FileMembershipStore
+    from ..resilience.membership import stripe_owner as owner_of
+    from ..utils.metrics import METRICS
+    from .mesh import data_mesh
+
+    import pyarrow.parquet as pq
+
+    root = f"{output_file}.membership"
+
+    def say(msg: str) -> None:
+        # stdout + flush: the chaos tests stream these lines to time their
+        # SIGKILLs, and operators of a 2-terminal run read them live.
+        print(f"elastic[{process_id}]: {msg}", flush=True)
+
+    if force and os.path.isdir(root):
+        shutil.rmtree(root)
+        say(f"removed leftover membership dir {root} (--force)")
+
+    fingerprint = _input_fingerprint(input_file)
+    config_hash = _config_fingerprint(config)
+    arm_from_env(process_id=process_id)
+
+    store = FileMembershipStore(root, process_id, lease_ttl_s)
+    store.register()
+    if TRACER.enabled:
+        # File-backend analogue of _align_trace_clocks: the first process
+        # to register wrote the run's wall-clock origin; every tracer
+        # shifts onto it, no collective needed.
+        t0 = store.t0_us()
+        if t0 is not None:
+            TRACER.align(
+                TRACER.wall_at_origin_us() - t0,
+                args={"origin_wall_us": t0, "backend": "file"},
+            )
+    interval = max(0.05, lease_ttl_s / 3.0)
+    heartbeat = LeaseHeartbeat(store, interval).start()
+
+    mesh = data_mesh() if len(jax.devices()) > 1 else None
+    pipeline = CompiledPipeline(
+        config, buckets=tuple(sorted(buckets)), batch_size=device_batch,
+        mesh=mesh,
+    )
 
     n_rows = pq.ParquetFile(input_file).metadata.num_rows
     stride = math.ceil(n_rows / max(num_processes, 1))
-    skip = min(process_id * stride, n_rows)
-    take = max(0, min(stride, n_rows - skip))
 
-    # Per-host dead-letter shard, merged by process 0 exactly like
-    # kept/excluded.  Created eagerly (DeadLetterSink writes the empty file
-    # up front) so the merge never races a host that recorded nothing.
-    deadletter = (
-        DeadLetterSink(f"{errors_file}.shard{process_id}")
-        if errors_file is not None
-        else None
+    def window(s: int) -> Tuple[int, int]:
+        # Identical striping to the lockstep path, computed from the input
+        # alone — every process (and every relaunch) derives the same
+        # windows without communicating.
+        skip = min(s * stride, n_rows)
+        return skip, max(0, min(stride, n_rows - skip))
+
+    def stripe_done(s: int, st: Optional[CheckpointState] = None) -> bool:
+        _skip, take = window(s)
+        if take <= 0:
+            return True
+        if st is None:
+            st = CheckpointState.load(store.stripe_dir(s))
+        return st is not None and st.rows_consumed >= take
+
+    my_token = {"rank": process_id, "incarnation": store.incarnation}
+    lineage = f"-r{process_id}x{store.incarnation}"
+    tracker = EpochTracker(process_id)
+    local = AggregationResult()
+    say(
+        f"joined membership (incarnation {store.incarnation}, "
+        f"{num_processes} stripe(s), lease ttl {lease_ttl_s:g}s)"
     )
 
-    read_errors = 0
-    docs: List[TextDocument] = []
-    stream = read_documents(
-        input_file,
-        text_column=text_column,
-        id_column=id_column,
-        batch_size=read_batch_size,
-        skip_rows=skip,
-    )
-    for item in islice(stream, take):  # one stream item per Parquet row
-        if isinstance(item, PipelineError):
-            read_errors += 1
-            if deadletter is not None:
-                deadletter.record_read_error(item)
-        else:
-            docs.append(item)
-
-    from ..ops.pipeline import CompiledPipeline
-
-    geometry = None
-    if auto_geometry:
-        # Geometry negotiation: each host histograms ITS shard's document
-        # lengths over the fixed shape-stable bin edges, the histograms are
-        # allgathered and summed elementwise, and every host derives the
-        # geometry from the identical merged histogram — so the lockstep
-        # round schedule (which depends on buckets and batch sizes) stays in
-        # agreement without shipping raw lengths across hosts.
-        from ..ops.geometry import (
-            geometry_from_histogram,
-            length_histogram,
-        )
-
-        hist = length_histogram([len(d.content) for d in docs])
-        hist = host_allgather(hist).sum(axis=0)
-        if hist.sum() > 0:
-            geometry = geometry_from_histogram(
-                hist, backend=jax.default_backend()
+    def self_fence() -> None:
+        if heartbeat.failed or not store.my_lease_fresh():
+            raise PipelineError(
+                f"rank {process_id} self-fenced: its liveness lease went "
+                f"stale (ttl {lease_ttl_s:g}s) or a newer incarnation of "
+                "this rank took over; committing now could race the "
+                "stripe's adopter, so this process stops instead"
             )
 
-    pipeline = CompiledPipeline(
-        config, buckets=tuple(sorted(buckets)), batch_size=device_batch,
-        mesh=mesh, geometry=geometry,
-    )
     try:
-        outcomes = run_local_shard(
-            config, docs, buckets=pipeline.geometry.buckets, mesh=mesh,
-            pipeline=pipeline,
-        )
+        while True:
+            self_fence()
+            live = store.live_ranks()
+            for msg in tracker.observe(live):
+                say(msg)
+            progressed = False
+            for s in range(num_processes):
+                _skip, take = window(s)
+                if take <= 0 or stripe_done(s):
+                    continue
+                if owner_of(s, live) != process_id:
+                    continue
+                st_dir = store.stripe_dir(s)
+                cur = CheckpointState.load(st_dir)
+                if cur is None or cur.owner != my_token:
+                    st = CheckpointState.adopt(
+                        st_dir, my_token,
+                        input_fingerprint=fingerprint,
+                        config_hash=config_hash,
+                    )
+                    if s != process_id:
+                        METRICS.inc("multihost_adopted_stripes_total")
+                        TRACER.instant(
+                            "stripe_adopted",
+                            {"stripe": s, "epoch": tracker.epoch},
+                        )
+                        say(
+                            f"adopted stripe {s} at row {st.rows_consumed}"
+                            f"/{take} (epoch {tracker.epoch})"
+                        )
+                    elif st.rows_consumed > 0:
+                        say(
+                            f"stripe {s} resume at row {st.rows_consumed}"
+                            f"/{take} (epoch {tracker.epoch})"
+                        )
+                else:
+                    st = cur
+                recorded = (
+                    DeviceGeometry.from_dict(st.geometry)
+                    if st.geometry is not None
+                    else None
+                )
+                if recorded is not None:
+                    if (
+                        recorded.fingerprint()
+                        != pipeline.geometry.fingerprint()
+                    ):
+                        # Chunk boundaries are batch flush barriers; a
+                        # different geometry would batch the remainder
+                        # differently than the original owner did.
+                        raise PipelineError(
+                            f"stripe {s} cursor was created with device "
+                            f"geometry {recorded.describe()}, but this "
+                            "process resolves to "
+                            f"{pipeline.geometry.describe()}; every "
+                            "elastic participant must run the identical "
+                            "--buckets/--device-batch"
+                        )
+                else:
+                    st.geometry = pipeline.geometry.to_dict()
 
-        shard_out = f"{output_file}.shard{process_id}"
-        shard_exc = f"{excluded_file}.shard{process_id}"
-        result = aggregate_results_from_stream(
-            iter(outcomes), shard_out, shard_exc, deadletter=deadletter
-        )
+                skip, take = window(s)
+                before = (
+                    st.received, st.success, st.filtered, st.errors,
+                    st.read_errors,
+                )
+
+                def fence(s=s, st_dir=st_dir) -> None:
+                    self_fence()
+                    if owner_of(s, store.live_ranks()) != process_id:
+                        raise StripeLost(
+                            f"stripe {s} ownership moved (membership "
+                            "changed)"
+                        )
+                    reloaded = CheckpointState.load(st_dir)
+                    if reloaded is not None and reloaded.owner != my_token:
+                        raise StripeLost(
+                            f"stripe {s} cursor claimed by "
+                            f"{reloaded.owner}"
+                        )
+
+                def on_chunk(state: CheckpointState, s=s, take=take) -> None:
+                    say(
+                        f"stripe {s} committed rows "
+                        f"{state.rows_consumed}/{take} "
+                        f"(epoch {tracker.epoch})"
+                    )
+
+                done = run_stripe_checkpointed(
+                    input_file,
+                    st_dir,
+                    state=st,
+                    skip_rows=skip,
+                    take_rows=take,
+                    chunk_size=read_batch_size,
+                    process_chunk=lambda items, on_err: (
+                        process_documents_device(
+                            config, items, on_read_error=on_err,
+                            pipeline=pipeline,
+                        )
+                    ),
+                    fence=fence,
+                    lineage=lineage,
+                    text_column=text_column,
+                    id_column=id_column,
+                    record_dead=errors_file is not None,
+                    on_chunk=on_chunk,
+                )
+                local.received += st.received - before[0]
+                local.success += st.success - before[1]
+                local.filtered += st.filtered - before[2]
+                local.errors += st.errors - before[3]
+                local.read_errors += st.read_errors - before[4]
+                progressed = True
+                if not done:
+                    say(f"stripe {s} lost to another owner; moving on")
+            if all(stripe_done(s) for s in range(num_processes)):
+                break
+            if not progressed:
+                time.sleep(interval)
     finally:
-        # The shard must be complete on disk before the totals barrier
-        # releases process 0 into the merge.
-        if deadletter is not None:
-            deadletter.close()
-    result.read_errors = read_errors
+        heartbeat.stop()
 
-    totals = np.array(
-        [result.received, result.success, result.filtered, result.errors,
-         result.read_errors],
-        dtype=np.int64,
+    live = store.live_ranks()
+    merger = min(live) if live else process_id
+    if process_id != merger:
+        store.withdraw()
+        say(f"all stripes consumed; rank {merger} merges; local done")
+        return local
+
+    # Merge duty: lowest live rank (fails over like stripe ownership —
+    # if the merger dies here, any relaunched/surviving rank re-enters,
+    # finds every stripe done, and repeats this idempotent, atomic merge).
+    cursors = [
+        CheckpointState.load(store.stripe_dir(s))
+        for s in range(num_processes)
+    ]
+
+    def parts(attr: str) -> List[str]:
+        return [
+            os.path.join(store.stripe_dir(s), name)
+            for s, cur in enumerate(cursors)
+            if cur is not None
+            for name in getattr(cur, attr)
+        ]
+
+    _commit_concat(output_file, parts("out_parts"), OUTPUT_SCHEMA)
+    _commit_concat(excluded_file, parts("excl_parts"), OUTPUT_SCHEMA)
+    if errors_file is not None:
+        _commit_concat(errors_file, parts("err_parts"), DEADLETTER_SCHEMA)
+    merged = AggregationResult()
+    for cur in cursors:
+        if cur is None:
+            continue
+        merged.received += cur.received
+        merged.success += cur.success
+        merged.filtered += cur.filtered
+        merged.errors += cur.errors
+        merged.read_errors += cur.read_errors
+    store.withdraw()
+    shutil.rmtree(root, ignore_errors=True)
+    say(
+        f"merged {num_processes} stripe(s): {merged.received} outcomes "
+        f"({merged.success} kept, {merged.filtered} excluded, "
+        f"{merged.errors} errors, {merged.read_errors} read errors)"
     )
-    # Barrier doubling as the totals exchange: every process must have
-    # closed its shard files before process 0 merges (host_allgather's
-    # blocking gets release only once every peer has posted).
-    all_totals = host_allgather(totals).reshape(-1, 5)
-
-    # Cross-host metrics aggregation: one more lockstep exchange carrying
-    # each process's metrics-delta snapshot (a few KiB of JSON), so host
-    # 0's report survives the other processes' exit.  Runs on EVERY
-    # process or on none — see the docstring contract.
-    host_reports = None
-    if run_report is not None:
-        now = metrics_snapshot()
-        local_delta = {
-            k: round(now.get(k, 0.0) - values_before.get(k, 0.0), 6)
-            for k in set(now) | set(values_before)
-            if now.get(k, 0.0) != values_before.get(k, 0.0)
-        }
-        host_reports = host_allgather_obj(
-            {
-                "process": process_id,
-                "wall_time_s": round(_time.perf_counter() - wall_t0, 3),
-                "counts": {
-                    "received": result.received,
-                    "success": result.success,
-                    "filtered": result.filtered,
-                    "errors": result.errors,
-                    "read_errors": result.read_errors,
-                },
-                "metrics": local_delta,
-            }
-        )
-
-    if process_id == 0:
-        merge_shard_files(
-            [
-                (final, [f"{final}.shard{i}" for i in range(num_processes)])
-                for final in finals
-            ]
-        )
-        g = all_totals.sum(axis=0)
-        merged = AggregationResult()
-        merged.received, merged.success, merged.filtered = int(g[0]), int(g[1]), int(g[2])
-        merged.errors, merged.read_errors = int(g[3]), int(g[4])
-        if host_reports is not None:
-            summed: dict = {}
-            for h in host_reports:
-                for k, v in h["metrics"].items():
-                    summed[k] = summed.get(k, 0.0) + v
-            report = build_run_report(
-                values=summed,
-                wall_time_s=max(h["wall_time_s"] for h in host_reports),
-                counts={
-                    "received": merged.received,
-                    "success": merged.success,
-                    "filtered": merged.filtered,
-                    "errors": merged.errors,
-                    "read_errors": merged.read_errors,
-                },
-                provenance=provenance,
-                hosts=host_reports,
-            )
-            write_run_report(run_report, report)
-        return merged
-    return result
+    return merged
 
 
 def _main(argv: Optional[Sequence[str]] = None) -> int:
@@ -805,6 +1541,23 @@ def _main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--device-batch", type=int, default=None)
     ap.add_argument("--auto-geometry", action="store_true")
     ap.add_argument("--force", action="store_true")
+    ap.add_argument(
+        "--exchange-deadline-s", type=float,
+        default=DEFAULT_EXCHANGE_DEADLINE_S,
+        help="budget for each lockstep KV exchange; on expiry a typed "
+        "PeerFailure names the rank(s) that never posted",
+    )
+    ap.add_argument(
+        "--lease-ttl-s", type=float, default=DEFAULT_LEASE_TTL_S,
+        help="liveness-lease TTL (renewed at TTL/3); a rank whose lease "
+        "is older is classified dead",
+    )
+    ap.add_argument(
+        "--elastic", action="store_true",
+        help="elastic membership: shared-filesystem leases + per-stripe "
+        "checkpoint cursors; survivors adopt dead ranks' stripes and "
+        "relaunched ranks rejoin in place",
+    )
     ap.add_argument(
         "--metrics-port", type=int, default=None,
         help="serve /metrics on this port + process-id (the offset keeps "
@@ -854,6 +1607,9 @@ def _main(argv: Optional[Sequence[str]] = None) -> int:
             errors_file=args.errors_file,
             force=args.force,
             run_report=args.run_report,
+            exchange_deadline_s=args.exchange_deadline_s,
+            lease_ttl_s=args.lease_ttl_s,
+            elastic=args.elastic,
             provenance={
                 "entry": "textblaster_tpu.parallel.multihost",
                 "pipeline_config": args.pipeline_config,

@@ -25,7 +25,13 @@ gives the broker-free executor a real one:
   the multi-host arm of the ladder: per lockstep round every host
   allgathers a fault flag and ALL hosts jointly retry (shared zero-jitter
   backoff), then jointly degrade the round to the host oracle, with
-  per-bucket breakers latched by the shared verdict sequence.
+  per-bucket breakers latched by the shared verdict sequence;
+* :mod:`~textblaster_tpu.resilience.membership` — elastic gang membership:
+  renewable liveness leases (KV store for lockstep runs, shared-filesystem
+  files for ``--elastic``), membership epochs that bump when the gang
+  shrinks/grows, deterministic stripe ownership with lowest-live-rank
+  adoption, and the typed :class:`PeerFailure` a deadline-bounded exchange
+  raises instead of hanging on a dead peer.
 """
 
 from .breaker import CircuitBreaker
@@ -36,6 +42,15 @@ from .deadletter import (
     read_error_row,
 )
 from .faults import FAULTS, FaultInjector, arm_from_env
+from .membership import (
+    EpochTracker,
+    FileMembershipStore,
+    KVLeaseStore,
+    LeaseHeartbeat,
+    MembershipConfig,
+    PeerFailure,
+    stripe_owner,
+)
 from .negotiated import NegotiatedGuard
 from .retry import (
     RetryPolicy,
@@ -48,9 +63,15 @@ __all__ = [
     "CircuitBreaker",
     "DEADLETTER_SCHEMA",
     "DeadLetterSink",
+    "EpochTracker",
     "FAULTS",
     "FaultInjector",
+    "FileMembershipStore",
+    "KVLeaseStore",
+    "LeaseHeartbeat",
+    "MembershipConfig",
     "NegotiatedGuard",
+    "PeerFailure",
     "RetryPolicy",
     "arm_from_env",
     "classify_error",
@@ -58,4 +79,5 @@ __all__ = [
     "is_retryable_error",
     "outcome_row",
     "read_error_row",
+    "stripe_owner",
 ]

@@ -14,7 +14,13 @@ Sites planted in this build:
 * ``"checkpoint.commit"`` — per checkpoint cursor commit
   (:meth:`textblaster_tpu.checkpoint.CheckpointState.save`);
 * ``"multihost.round"``   — per multi-host lockstep round launch
-  (:meth:`textblaster_tpu.ops.pipeline.CompiledPipeline.dispatch_lockstep`).
+  (:meth:`textblaster_tpu.ops.pipeline.CompiledPipeline.dispatch_lockstep`);
+* ``"multihost.lease"``   — per liveness-lease renewal
+  (:mod:`textblaster_tpu.resilience.membership`, KV and file backends — an
+  armed fault makes this process's lease go stale, so peers evict it);
+* ``"multihost.rejoin"``  — per stripe-cursor claim/adoption
+  (:meth:`textblaster_tpu.checkpoint.CheckpointState.adopt` on the
+  ``--elastic`` path).
 
 The injector is **inert by default**: with nothing armed, :meth:`fire` is a
 single attribute load + falsy check and keeps no per-call state, so

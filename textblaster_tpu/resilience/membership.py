@@ -1,0 +1,399 @@
+"""Elastic gang membership: leased liveness, epochs, and stripe ownership.
+
+PR 4 made multi-host rounds fault-tolerant *within* a fixed gang; this
+module makes the gang itself a first-class, mutable object.  Two backends,
+matched to what the transports can actually survive (measured on this
+container's jax 0.4.x):
+
+* **KV leases** (:class:`KVLeaseStore`) ride the ``jax.distributed``
+  coordination-service key-value store — the same transport
+  ``host_allgather`` uses on multi-process CPU.  Each process renews
+  ``textblast/lease/{rank}`` every ``ttl/3``; when a lockstep exchange's
+  deadline expires, the survivor reads the lease table and classifies the
+  ranks that never posted as *dead* (lease older than the TTL) or *slow*
+  (lease fresh — alive but late), then raises a typed
+  :class:`~textblaster_tpu.errors.PeerFailure` naming them.  This backend
+  diagnoses failures but cannot outlive them: the coordination service
+  force-terminates every healthy task ~90-100 s after a peer stops
+  heartbeating (client-side fatal error polling), so exchange deadlines
+  must sit well under that window to be useful.
+
+* **File leases** (:class:`FileMembershipStore`) live in a run directory
+  on the shared filesystem the shard merge already assumes.  They carry
+  the ``--elastic`` mode, where processes are *not* coupled through the
+  coordination service at all: each rank owns an input stripe with a
+  checkpointed cursor, renews a lease file, and survivors deterministically
+  adopt orphaned stripes (lowest live rank) when a lease expires.  A
+  relaunched process re-registers a lease under a fresh incarnation and
+  reclaims its stripe at the owner's next chunk boundary — restart-in-place
+  with zero completed chunks replayed.
+
+Epoch semantics (:class:`EpochTracker`): the membership epoch starts at 1
+and bumps whenever the observed live set changes — an eviction (lease
+expired) and a rejoin (new lease appears) each bump it.  Epochs namespace
+the KV exchange keys (``parallel/multihost.py``), label trace instants and
+metrics, and define the boundaries at which elastic ownership may move.
+
+Fencing is lease-based (GFS/Chubby style), not compare-and-swap: an owner
+self-fences before every chunk commit (own lease must still be fresh and
+the cursor must still name it), so the race window between an adopter's
+claim and a zombie owner's last commit is milliseconds against a TTL of
+seconds.  Clock skew between hosts must be small relative to the TTL —
+the same assumption every lease system on a shared filesystem makes.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..errors import PeerFailure, PipelineError
+from ..utils.metrics import METRICS
+from ..utils.trace import TRACER
+from .faults import FAULTS
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "PeerFailure",
+    "MembershipConfig",
+    "KVLeaseStore",
+    "FileMembershipStore",
+    "LeaseHeartbeat",
+    "EpochTracker",
+    "stripe_owner",
+    "LEASE_PREFIX",
+]
+
+#: KV-store namespace for per-rank liveness leases.
+LEASE_PREFIX = "textblast/lease/"
+
+DEFAULT_LEASE_TTL_S = 10.0
+DEFAULT_EXCHANGE_DEADLINE_S = 300.0
+
+
+@dataclass
+class MembershipConfig:
+    """Knobs for the membership layer (CLI: ``--elastic``,
+    ``--lease-ttl-s``, ``--exchange-deadline-s``)."""
+
+    elastic: bool = False
+    lease_ttl_s: float = DEFAULT_LEASE_TTL_S
+    exchange_deadline_s: float = DEFAULT_EXCHANGE_DEADLINE_S
+
+    def heartbeat_interval_s(self) -> float:
+        """Renewal cadence: 3 renewals per TTL, floored for tiny test TTLs."""
+        return max(0.05, self.lease_ttl_s / 3.0)
+
+    def validate(self) -> None:
+        if self.lease_ttl_s <= 0:
+            raise PipelineError(
+                f"--lease-ttl-s must be positive, got {self.lease_ttl_s}"
+            )
+        if self.exchange_deadline_s <= 0:
+            raise PipelineError(
+                "--exchange-deadline-s must be positive, got "
+                f"{self.exchange_deadline_s}"
+            )
+
+
+def _kv_set(client, key: str, value: str) -> None:
+    """``key_value_set`` with overwrite (leases are renewed in place; a
+    restarted process must be able to re-post).  Older jaxlib clients
+    lack the keyword — fall back to the create-only form."""
+    try:
+        client.key_value_set(key, value, allow_overwrite=True)
+    except TypeError:  # pragma: no cover - jaxlib version dependent
+        client.key_value_set(key, value)
+
+
+class KVLeaseStore:
+    """Per-rank liveness leases in the ``jax.distributed`` KV store.
+
+    The value is the renewing host's wall-clock seconds
+    (``f"{time.time():.3f}"``); freshness is judged against the reader's
+    wall clock, so host clocks must agree to well within the TTL (they
+    share NTP on any real deployment; the 2-process tests share a box).
+    """
+
+    def __init__(self, client, rank: int, ttl_s: float) -> None:
+        self.client = client
+        self.rank = int(rank)
+        self.ttl_s = float(ttl_s)
+
+    def post(self) -> None:
+        """Renew this rank's lease (the heartbeat body)."""
+        FAULTS.fire("multihost.lease")
+        _kv_set(self.client, f"{LEASE_PREFIX}{self.rank}", f"{time.time():.3f}")
+        METRICS.inc("multihost_lease_renewals_total")
+
+    def read_all(self) -> Dict[int, float]:
+        """All ranks' lease timestamps, ``{rank: wall_seconds}``."""
+        try:
+            entries = self.client.key_value_dir_get(LEASE_PREFIX)
+        except Exception as e:  # pragma: no cover - service-state dependent
+            logger.warning("lease table read failed: %s", e)
+            return {}
+        leases: Dict[int, float] = {}
+        for item in entries or ():
+            # jaxlib returns (key, value) pairs; be liberal about shape.
+            try:
+                key, value = item[0], item[1]
+                leases[int(str(key).rsplit("/", 1)[-1])] = float(value)
+            except (ValueError, IndexError, TypeError):
+                continue
+        return leases
+
+    def resolve_liveness(
+        self, ranks: Sequence[int], now: Optional[float] = None
+    ) -> Tuple[List[int], List[int]]:
+        """Classify ``ranks`` into ``(dead, slow)`` against the lease table.
+
+        A rank with no lease at all is dead (it never registered, or its
+        keys were cleaned); a rank whose lease is older than the TTL is
+        dead; a rank with a fresh lease is slow — alive but late."""
+        now = time.time() if now is None else now
+        leases = self.read_all()
+        dead, slow = [], []
+        for r in ranks:
+            ts = leases.get(int(r))
+            if ts is None or now - ts > self.ttl_s:
+                dead.append(int(r))
+            else:
+                slow.append(int(r))
+        return dead, slow
+
+
+class FileMembershipStore:
+    """Shared-filesystem membership for ``--elastic`` runs.
+
+    Layout under ``root`` (created on register)::
+
+        t0.json            — wall-clock trace origin, written once (O_EXCL)
+        lease.rank{r}.json — {"rank", "incarnation", "time", "pid"}
+        stripe{s}/         — per-stripe checkpoint dir (cursor + parts)
+
+    Lease writes are atomic (tmp + ``os.replace``) so a reader never sees
+    a torn JSON.  Incarnations distinguish a relaunched rank from its dead
+    predecessor: lease freshness answers *whether* rank r is live, the
+    incarnation answers *which* launch of it.
+    """
+
+    def __init__(
+        self,
+        root: str,
+        rank: int,
+        ttl_s: float,
+        incarnation: Optional[str] = None,
+    ) -> None:
+        self.root = root
+        self.rank = int(rank)
+        self.ttl_s = float(ttl_s)
+        # Unique per launch: wall-clock ns + pid.  Wall clock is used only
+        # for uniqueness, never ordering.
+        self.incarnation = incarnation or f"{time.time_ns():x}-{os.getpid()}"
+
+    # --- registration & heartbeat -------------------------------------------
+
+    def register(self) -> None:
+        os.makedirs(self.root, exist_ok=True)
+        t0 = os.path.join(self.root, "t0.json")
+        if not os.path.exists(t0):
+            try:
+                fd = os.open(t0, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                pass  # a peer won the race — its origin is the run's
+            else:
+                with os.fdopen(fd, "w", encoding="utf-8") as f:
+                    json.dump({"wall_us": int(time.time() * 1e6)}, f)
+        self.post()
+
+    def post(self) -> None:
+        """Renew this rank's lease file (the heartbeat body)."""
+        FAULTS.fire("multihost.lease")
+        path = self._lease_path(self.rank)
+        tmp = f"{path}.tmp.{self.incarnation}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(
+                {
+                    "rank": self.rank,
+                    "incarnation": self.incarnation,
+                    "time": time.time(),
+                    "pid": os.getpid(),
+                },
+                f,
+            )
+        os.replace(tmp, path)
+        METRICS.inc("multihost_lease_renewals_total")
+
+    def withdraw(self) -> None:
+        """Remove this rank's lease (clean exit: don't look dead, be gone)."""
+        try:
+            os.remove(self._lease_path(self.rank))
+        except OSError:
+            pass
+
+    def _lease_path(self, rank: int) -> str:
+        return os.path.join(self.root, f"lease.rank{int(rank)}.json")
+
+    # --- reads ---------------------------------------------------------------
+
+    def read_leases(self) -> Dict[int, dict]:
+        leases: Dict[int, dict] = {}
+        try:
+            names = os.listdir(self.root)
+        except FileNotFoundError:
+            return leases
+        for name in names:
+            if not (name.startswith("lease.rank") and name.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(self.root, name), encoding="utf-8") as f:
+                    d = json.load(f)
+                leases[int(d["rank"])] = d
+            except (OSError, ValueError, KeyError):
+                continue  # torn/foreign file: not a live lease
+        return leases
+
+    def live_ranks(self, now: Optional[float] = None) -> List[int]:
+        """Sorted ranks whose lease is fresher than the TTL."""
+        now = time.time() if now is None else now
+        return sorted(
+            r
+            for r, d in self.read_leases().items()
+            if now - float(d.get("time", 0.0)) <= self.ttl_s
+        )
+
+    def my_lease_fresh(self, now: Optional[float] = None) -> bool:
+        """Self-fence predicate: own lease file present, fresh, and still
+        this incarnation's (a successor overwriting it means a newer launch
+        of this rank took over)."""
+        now = time.time() if now is None else now
+        d = self.read_leases().get(self.rank)
+        return (
+            d is not None
+            and d.get("incarnation") == self.incarnation
+            and now - float(d.get("time", 0.0)) <= self.ttl_s
+        )
+
+    def t0_us(self) -> Optional[int]:
+        try:
+            with open(os.path.join(self.root, "t0.json"), encoding="utf-8") as f:
+                return int(json.load(f)["wall_us"])
+        except (OSError, ValueError, KeyError):
+            return None
+
+    def stripe_dir(self, stripe: int) -> str:
+        path = os.path.join(self.root, f"stripe{int(stripe)}")
+        os.makedirs(path, exist_ok=True)
+        return path
+
+
+class LeaseHeartbeat:
+    """Daemon thread renewing a lease store every ``interval_s``.
+
+    Renewal failures are tolerated ``max_failures`` times in a row (a
+    shared-filesystem blip should not kill the renewer), then the thread
+    stops and ``failed`` latches — the owner's next self-fence sees the
+    stale lease and stops committing, which is exactly the contract the
+    adopters rely on."""
+
+    def __init__(self, store, interval_s: float, max_failures: int = 5) -> None:
+        self.store = store
+        self.interval_s = float(interval_s)
+        self.max_failures = int(max_failures)
+        self.failed = False
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="textblast-lease", daemon=True
+        )
+
+    def start(self) -> "LeaseHeartbeat":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+
+    def _run(self) -> None:
+        failures = 0
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.store.post()
+                failures = 0
+            except Exception as e:  # noqa: BLE001 — renewal is best-effort
+                failures += 1
+                logger.warning(
+                    "lease renewal failed (%d/%d): %s",
+                    failures, self.max_failures, e,
+                )
+                if failures >= self.max_failures:
+                    self.failed = True
+                    logger.error(
+                        "lease renewal abandoned after %d consecutive "
+                        "failures; this process will self-fence at its next "
+                        "commit boundary", failures,
+                    )
+                    return
+
+
+def stripe_owner(stripe: int, live: Sequence[int]) -> Optional[int]:
+    """Deterministic ownership rule every rank computes identically:
+    stripe ``s`` belongs to rank ``s`` while rank ``s`` is live; an
+    orphaned stripe is adopted by the **lowest live rank** (the same
+    successor rule that fails merge duty over).  ``None`` when nobody is
+    live to own it."""
+    live = sorted(int(r) for r in live)
+    if not live:
+        return None
+    return int(stripe) if int(stripe) in live else live[0]
+
+
+class EpochTracker:
+    """Observes live-set changes and turns them into epoch bumps.
+
+    ``observe(live)`` returns a list of human-readable transition strings
+    (empty when nothing changed) and maintains the counters/instants:
+    ``multihost_membership_epoch`` (gauge), ``multihost_evictions_total``
+    and ``multihost_rejoins_total``, plus ``membership_evict`` /
+    ``membership_rejoin`` trace instants carrying the epoch."""
+
+    def __init__(self, rank: int) -> None:
+        self.rank = int(rank)
+        self.epoch = 1
+        self.live: Optional[Tuple[int, ...]] = None
+        METRICS.set("multihost_membership_epoch", self.epoch)
+
+    def observe(self, live: Sequence[int]) -> List[str]:
+        now = tuple(sorted(int(r) for r in live))
+        if self.live is None:
+            self.live = now
+            return []
+        if now == self.live:
+            return []
+        events: List[str] = []
+        evicted = set(self.live) - set(now)
+        joined = set(now) - set(self.live)
+        self.epoch += 1
+        METRICS.set("multihost_membership_epoch", self.epoch)
+        for r in sorted(evicted):
+            METRICS.inc("multihost_evictions_total")
+            TRACER.instant(
+                "membership_evict", {"rank": r, "epoch": self.epoch}
+            )
+            events.append(f"evicted rank {r} (lease expired); epoch {self.epoch}")
+        for r in sorted(joined):
+            METRICS.inc("multihost_rejoins_total")
+            TRACER.instant(
+                "membership_rejoin", {"rank": r, "epoch": self.epoch}
+            )
+            events.append(f"rank {r} rejoined; epoch {self.epoch}")
+        self.live = now
+        return events

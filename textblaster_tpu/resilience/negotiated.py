@@ -115,6 +115,16 @@ class NegotiatedGuard:
         flags = host_allgather(np.array([1 if local_fault else 0]))
         return bool(flags.max() > 0)
 
+    @staticmethod
+    def _epoch() -> int:
+        """Current membership epoch, for labeling verdict trace instants —
+        an epoch-aware Perfetto timeline shows which gang composition a
+        retry/degradation happened under (lazy import, same cycle-avoidance
+        as :meth:`_negotiate`)."""
+        from ..parallel.multihost import current_exchange_epoch
+
+        return current_exchange_epoch()
+
     # --- breaker ------------------------------------------------------------
 
     def bucket_degraded(self, bucket: int) -> bool:
@@ -174,11 +184,14 @@ class NegotiatedGuard:
             TRACER.instant(
                 "negotiated_verdict",
                 {"bucket": bucket, "local_fault": local_fault,
-                 "attempt": attempt},
+                 "attempt": attempt, "epoch": self._epoch()},
             )
             if attempt >= self.policy.max_retries:
                 METRICS.inc("resilience_negotiated_degraded_rounds_total")
-                TRACER.instant("negotiated_degraded", {"bucket": bucket})
+                TRACER.instant(
+                    "negotiated_degraded",
+                    {"bucket": bucket, "epoch": self._epoch()},
+                )
                 self.breakers[bucket].record_failure(
                     "negotiated round retries exhausted"
                 )
@@ -194,7 +207,8 @@ class NegotiatedGuard:
             METRICS.inc("resilience_negotiated_retries_total")
             TRACER.instant(
                 "negotiated_retry",
-                {"bucket": bucket, "attempt": attempt, "backoff_s": delay},
+                {"bucket": bucket, "attempt": attempt, "backoff_s": delay,
+                 "epoch": self._epoch()},
             )
             logger.warning(
                 "Negotiated retry %d/%d of lockstep round (bucket %s) on "

@@ -185,6 +185,35 @@ _SPECS: Dict[str, Tuple[str, str]] = {
         "counter",
         "Stale *.shard* leftovers from prior runs removed under --force",
     ),
+    # Elastic gang membership (resilience/membership.py): leased liveness,
+    # deadline-bounded exchanges, and stripe adoption for multi-host runs.
+    "multihost_membership_epoch": (
+        "gauge",
+        "Current membership epoch (starts at 1, bumps whenever the observed "
+        "live set shrinks or grows)",
+    ),
+    "multihost_evictions_total": (
+        "counter",
+        "Peers evicted from the gang after their liveness lease expired",
+    ),
+    "multihost_rejoins_total": (
+        "counter",
+        "Peers observed rejoining the gang with a fresh lease (restart-in-"
+        "place)",
+    ),
+    "multihost_adopted_stripes_total": (
+        "counter",
+        "Orphaned input stripes adopted from an evicted peer (--elastic)",
+    ),
+    "multihost_peer_failures_total": (
+        "counter",
+        "Lockstep exchanges aborted with a typed PeerFailure (deadline "
+        "expired with peers missing, or a peer posted malformed data)",
+    ),
+    "multihost_lease_renewals_total": (
+        "counter",
+        "Liveness lease renewals posted by this process's heartbeat",
+    ),
     # Overlapped-pipeline stage accounting (no reference equivalent).  The
     # counters are wall seconds spent *inside* each stage, summed across
     # worker threads; with overlap on, stages run concurrently, so the sum

@@ -102,6 +102,7 @@ class Tracer:
         self._fh = None
         self._wrote_any = False
         self._t0 = 0.0
+        self._offset_us = 0  # cross-host clock alignment (align())
         self._pid = 0
         self._process_name = "textblast"
         self._tids: Dict[int, int] = {}  # thread ident -> compact tid
@@ -131,6 +132,7 @@ class Tracer:
             self._fh = None
             self._wrote_any = False
             self._t0 = time.perf_counter()
+            self._offset_us = 0
             self._pid = int(pid)
             self._process_name = process_name
             if path is not None:
@@ -171,6 +173,41 @@ class Tracer:
         with self._lock:
             out, self._ring = self._ring, []
             return out
+
+    # --- cross-host clock alignment -----------------------------------------
+
+    def wall_at_origin_us(self) -> int:
+        """This trace's time origin (``ts`` 0) as wall-clock microseconds.
+
+        ``ts`` values are ``perf_counter`` deltas from ``configure()``; to
+        put several hosts' traces on one Perfetto timeline, each host maps
+        its origin onto the shared wall clock and shifts by the difference
+        (:meth:`align`)."""
+        return int((time.time() - (time.perf_counter() - self._t0)) * 1e6)
+
+    def align(self, offset_us: int, args: Optional[Dict[str, Any]] = None) -> None:
+        """Shift every *subsequent* event's ``ts`` by ``offset_us`` and
+        record a ``trace_clock_offset`` metadata event documenting it.
+
+        Multihost runs call this once after the startup clock handshake
+        (``parallel/multihost.py _align_trace_clocks``): host ``i``'s offset
+        is its origin's wall-clock distance from the earliest host's origin,
+        so concatenated per-host traces share one timeline instead of each
+        starting at ``ts`` 0.  Events emitted before the handshake (tracer
+        setup, config loading) keep their unshifted, near-zero timestamps.
+        """
+        if not self.enabled:
+            return
+        self._offset_us = int(offset_us)
+        self._emit(
+            {
+                "name": "trace_clock_offset",
+                "ph": "M",
+                "pid": self._pid,
+                "tid": 0,
+                "args": {"offset_us": int(offset_us), **(args or {})},
+            }
+        )
 
     # --- recording ----------------------------------------------------------
 
@@ -215,7 +252,7 @@ class Tracer:
     # --- internals ----------------------------------------------------------
 
     def _now_us(self) -> int:
-        return int((time.perf_counter() - self._t0) * 1e6)
+        return int((time.perf_counter() - self._t0) * 1e6) + self._offset_us
 
     def _tid(self) -> int:
         """Compact per-thread lane id; first sight emits the thread_name
@@ -248,7 +285,7 @@ class Tracer:
             {
                 "name": name,
                 "ph": "X",
-                "ts": int((t0 - self._t0) * 1e6),
+                "ts": int((t0 - self._t0) * 1e6) + self._offset_us,
                 "dur": max(0, int((t1 - t0) * 1e6)),
                 "pid": self._pid,
                 "tid": self._tid(),
