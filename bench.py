@@ -660,12 +660,61 @@ def main() -> int:
     # parallel compiles cost ~the slowest program instead of the sum — the
     # round-3 cold warmup was 459s of serial tunnel compiles.
     t0 = time.perf_counter()
-    compile_s = pipeline.warmup_parallel()
-    _log(f"parallel AOT compile done in {compile_s:.1f}s")
+    warm_stats = pipeline.warmup_parallel()
+    compile_s = warm_stats.total_s
+    _log(
+        f"parallel AOT warmup done in {warm_stats.total_s:.1f}s "
+        f"(trace {warm_stats.trace_s:.1f}s, compile {warm_stats.compile_s:.1f}s, "
+        f"cache-load {warm_stats.cache_load_s:.2f}s, "
+        f"{warm_stats.cache_hits}/{warm_stats.programs} AOT hits)"
+    )
     warm = [d.copy() for d in docs]
     list(process_documents_device(config, iter(warm), pipeline=pipeline))
     warmup_s = time.perf_counter() - t0
     _log(f"device warmup (compile+first pass) done in {warmup_s:.1f}s")
+
+    # Cold-vs-warm AOT cache A/B: a FRESH CompiledPipeline against the store
+    # the warmup above just populated measures exactly what a re-invocation
+    # pays — executable loads instead of trace+compile.  The first warmup's
+    # stats stand in for the cold side when it really ran cold (no hits).
+    aot_ab = {"supported": False}
+    if os.environ.get("BENCH_AOT_AB", "1") != "0":
+        try:
+            from textblaster_tpu.utils.compile_cache import (
+                aot_cache_enabled,
+                aot_cache_supported,
+            )
+
+            aot_ab["supported"] = aot_cache_supported() and aot_cache_enabled()
+            if aot_ab["supported"]:
+                p_warm = CompiledPipeline(
+                    config,
+                    buckets=bench_buckets,
+                    batch_size=device_batch,
+                    geometry=geometry,
+                )
+                ws = p_warm.warmup_parallel()
+                aot_ab.update(
+                    cold_warmup_s=(
+                        round(warm_stats.total_s, 3)
+                        if warm_stats.cache_hits == 0
+                        else None
+                    ),
+                    cold_cache_hits=warm_stats.cache_hits,
+                    warm_warmup_s=round(ws.total_s, 3),
+                    warm_cache_load_s=round(ws.cache_load_s, 3),
+                    warm_cache_hits=ws.cache_hits,
+                    programs=ws.programs,
+                )
+                _log(
+                    f"AOT cache A/B: warm start {ws.total_s:.3f}s "
+                    f"({ws.cache_hits}/{ws.programs} hits) vs "
+                    f"cold {warm_stats.total_s:.1f}s"
+                )
+                del p_warm
+        except Exception as e:  # never bill a cache problem to the bench
+            aot_ab["error"] = str(e)
+            _log(f"AOT cache A/B skipped: {e}")
 
     from textblaster_tpu.utils.metrics import (
         METRICS,
@@ -772,6 +821,72 @@ def main() -> int:
         1 for k, v in host_by_id.items() if dev_by_id.get(k) == v
     )
     parity = agree / max(len(host_by_id), 1)
+
+    # --- Pallas kernel on/off A/B (BENCH_PALLAS=0 skips).  A fresh pipeline
+    # traced under TEXTBLAST_PALLAS=off runs the lax scans/sorts; the default
+    # pipeline runs whatever kernels the backend supports.  Decisions must be
+    # byte-identical three ways (kernels-on vs kernels-off vs host oracle) —
+    # the kernels are an execution-schedule change, never a semantic one.  On
+    # XLA:CPU both sides trace the same lax programs (kernels auto-decline),
+    # so the A/B doubles as the no-regression check there.
+    pallas_report = None
+    if os.environ.get("BENCH_PALLAS", "1") != "0":
+        from textblaster_tpu.ops.pallas_scan import pallas_scan_supported
+        from textblaster_tpu.ops.pallas_sort import pallas_sort_supported
+
+        def _kernel_pass(p):
+            run = [d.copy() for d in docs]
+            t0 = time.perf_counter()
+            outs = list(
+                process_documents_device(config, iter(run), pipeline=p)
+            )
+            return len(outs) / (time.perf_counter() - t0), outs
+
+        try:
+            scan_active = pallas_scan_supported()
+            sort_active = pallas_sort_supported()
+            prev_pallas = os.environ.get("TEXTBLAST_PALLAS")
+            os.environ["TEXTBLAST_PALLAS"] = "off"
+            try:
+                p_off = CompiledPipeline(
+                    config,
+                    buckets=bench_buckets,
+                    batch_size=device_batch,
+                    geometry=geometry,
+                )
+                p_off.warmup_parallel()
+                _kernel_pass(p_off)  # untimed warm pass
+                off_rate, off_out = _kernel_pass(p_off)
+            finally:
+                if prev_pallas is None:
+                    os.environ.pop("TEXTBLAST_PALLAS", None)
+                else:
+                    os.environ["TEXTBLAST_PALLAS"] = prev_pallas
+            on_rate, on_out = _kernel_pass(pipeline)
+            on_by_id = {o.document.id: o.kind for o in on_out}
+            off_by_id = {o.document.id: o.kind for o in off_out}
+            three_way = sum(
+                1
+                for k, v in host_by_id.items()
+                if on_by_id.get(k) == v and off_by_id.get(k) == v
+            ) / max(len(host_by_id), 1)
+            pallas_report = {
+                "scan_kernel_active": scan_active,
+                "sort_kernel_active": sort_active,
+                "on_docs_per_sec": round(on_rate, 2),
+                "off_docs_per_sec": round(off_rate, 2),
+                "speedup": round(on_rate / off_rate, 4),
+                "parity_on_off_host": round(three_way, 6),
+            }
+            _log(
+                f"pallas A/B: {on_rate:.1f} docs/s on vs {off_rate:.1f} off "
+                f"(x{pallas_report['speedup']}, scan_active={scan_active}, "
+                f"3-way parity {three_way:.4f})"
+            )
+            del p_off
+        except Exception as e:  # never bill a kernel A/B problem to the bench
+            pallas_report = {"error": str(e)}
+            _log(f"pallas A/B skipped: {e}")
 
     # --- Negotiated fault-guard overhead, fault-free (BENCH_RESILIENCE=0
     # skips).  The multi-host lockstep rounds run under the negotiated guard
@@ -936,8 +1051,21 @@ def main() -> int:
         "geometry": pipeline.geometry.to_dict(),
         "occupancy": occ_report,
         "platform": jax.default_backend(),
+        # Warmup cost, split by where it went: trace (serial Python),
+        # compile (XLA, summed across pool threads), AOT-cache executable
+        # loads.  warmup_s additionally includes the full warm pass.
         "warmup_s": round(warmup_s, 1),
         "warmup_compile_s": round(compile_s, 1),
+        "warmup_trace_s": round(warm_stats.trace_s, 2),
+        "warmup_cache_load_s": round(warm_stats.cache_load_s, 3),
+        "warmup_programs": warm_stats.programs,
+        "warmup_aot_hits": warm_stats.cache_hits,
+        # Cold-vs-warm serialized-executable cache A/B: what a re-invocation
+        # with the same geometry/config/jax pays instead of recompiling.
+        "aot_cache": aot_ab,
+        # Pallas kernel on/off A/B + three-way decision parity
+        # (kernels-on vs kernels-off vs host oracle).
+        **({"pallas": pallas_report} if pallas_report else {}),
         # Per-stage wall seconds across the 3 timed passes + the host-bound
         # vs device-bound verdict (stages overlap, so the sum can exceed
         # wall time; compare stages to each other).

@@ -45,10 +45,22 @@ def _docs():
 def test_warmup_parallel_installs_dispatchable_programs():
     config = parse_pipeline_config(YAML)
     pipeline = CompiledPipeline(config, buckets=(256, 512), batch_size=16)
-    n_programs = len(pipeline.buckets) * len(pipeline.phases)
-    dt = pipeline.warmup_parallel()
-    assert dt >= 0.0
+    # Full-geometry programs plus the degradation ladder's half-split rows
+    # (16 -> 8), so a mid-incident split retry never compiles cold.
+    n_programs = len(pipeline.buckets) * len(pipeline.phases) * 2
+    stats = pipeline.warmup_parallel()
+    assert float(stats) >= 0.0
+    assert stats.programs == n_programs
+    assert stats.trace_s >= 0.0 and stats.compile_s >= 0.0
+    assert stats.cache_load_s >= 0.0
+    # Every job either hit or missed the AOT store — unless the store is
+    # unavailable/bypassed, in which case neither counter moves.
+    assert stats.cache_hits + stats.cache_misses in (0, n_programs)
+    d = stats.to_dict()
+    assert d["programs"] == n_programs
     assert len(pipeline._jitted) == n_programs
+    # Split-row entries carry the rows in the cache key.
+    assert any(len(k) == 3 and k[2] == 8 for k in pipeline._jitted)
     # AOT Compiled objects, not jit wrappers: nothing left to trace.
     assert all(not hasattr(f, "lower") for f in pipeline._jitted.values())
 

@@ -446,6 +446,7 @@ def run_checkpointed(
     progress: Optional[Callable[[AggregationResult], None]] = None,
     stop_after_chunks: Optional[int] = None,
     errors_file: Optional[str] = None,
+    warmup: Optional[bool] = None,
 ) -> AggregationResult:
     """Run the pipeline with chunk-level checkpointing (resume by default).
 
@@ -639,6 +640,10 @@ def run_checkpointed(
         # Recorded from the constructed pipeline (mesh rounding included) so
         # the resume check compares like with like.
         state.geometry = pipeline.geometry.to_dict()
+
+        from .ops.pipeline import maybe_warmup
+
+        maybe_warmup(pipeline, warmup)
 
         def process_chunk(items) -> Iterator[ProcessingOutcome]:
             return process_documents_device(

@@ -102,6 +102,16 @@ def build_parser() -> argparse.ArgumentParser:
                           "thread) and run the serial path.  Outputs are "
                           "byte-identical either way; this is the "
                           "escape hatch and A/B baseline")
+    run.add_argument("--warmup", choices=("auto", "on", "off"), default="auto",
+                     help="Pre-compile every (bucket, phase) device program "
+                          "before the stream starts, consulting the "
+                          "serialized AOT executable cache first (a warm "
+                          "start loads finished executables in well under a "
+                          "second instead of re-compiling for 15-29 s).  "
+                          "'auto' warms on accelerator backends and stays "
+                          "lazy on CPU; TEXTBLAST_WARMUP overrides the "
+                          "default, TEXTBLAST_NO_COMPILE_CACHE=1 disables "
+                          "the executable cache itself")
     run.add_argument("--metrics-port", type=int, default=None,
                      help="Port for the Prometheus metrics HTTP endpoint "
                           "(with --coordinator the port is offset by "
@@ -313,6 +323,13 @@ def _cmd_run(args: argparse.Namespace) -> int:
         if val is not None and val <= 0:
             print(f"{name} must be positive, got {val}", file=sys.stderr)
             return 1
+    # --warmup on/off overrides the backend-default policy everywhere; the
+    # env form reaches paths that build their pipeline deep inside the
+    # multi-host negotiation layers (ops.pipeline.should_warmup reads it).
+    warmup_opt = {"auto": None, "on": True, "off": False}[args.warmup]
+    if warmup_opt is not None:
+        os.environ["TEXTBLAST_WARMUP"] = "1" if warmup_opt else "0"
+
     # Entered manually (not a with-block) so the existing dispatch block
     # keeps its indentation; TRACER.close() must run on every path so a
     # failed run still leaves a loadable (truncation-tolerant) trace.
@@ -373,6 +390,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
                 auto_geometry=args.auto_geometry,
                 progress=progress.update,
                 errors_file=args.errors_file,
+                warmup=warmup_opt,
             )
             progress.finish()
         else:
@@ -392,6 +410,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
                 auto_geometry=args.auto_geometry,
                 quiet=args.quiet,
                 errors_file=args.errors_file,
+                warmup=warmup_opt,
             )
     except PeerFailure as e:
         # A dead gang member: run_multihost already abandoned the

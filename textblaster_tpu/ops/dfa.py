@@ -15,6 +15,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .device import assoc_scan1, latch_scan, use_sort_tables
+from .pallas_scan import dfa_compose_scan, pallas_scan_ok
 
 __all__ = ["dfa_states", "citation_spans"]
 
@@ -57,7 +58,12 @@ def dfa_states(
         ident = 0
         for s in range(n_states):
             ident |= s << (4 * s)
-        packed = assoc_scan1(compose, np.int32(ident), fns, axis=1)
+        if pallas_scan_ok(*fns.shape):
+            # Blocked VMEM kernel — same int32 composition, bit-identical
+            # (pallas_scan module docstring; parity fuzzed in tests).
+            packed = dfa_compose_scan(fns, n_states)
+        else:
+            packed = assoc_scan1(compose, np.int32(ident), fns, axis=1)
         return (packed >> (4 * start_state)) & 15
 
     table = jnp.asarray(transition, dtype=jnp.int32)  # [S, N]

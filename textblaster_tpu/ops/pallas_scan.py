@@ -1,0 +1,265 @@
+"""VMEM-resident blocked associative scans (DFA composition, rolling hashes).
+
+The per-row hot scans — DFA matching over nibble-packed transition maps
+(:mod:`.dfa`) and the segmented polynomial-hash streams feeding the
+repetition/duplicate statistics (:mod:`.stats`) — run as log-depth
+``lax.associative_scan`` under XLA, which materializes every doubling
+level's ``[B, L]`` intermediate in HBM.  This module runs the *same
+associative ops* as a blocked sequential scan instead: the grid tiles rows
+(8-row sublane tiles), each tile stays resident in VMEM while an in-kernel
+``fori_loop`` walks fixed-width lane blocks, scanning each block with
+Hillis–Steele doubling (circular lane rolls masked to the op identity) and
+folding a per-row carry across blocks — intermediate state never
+round-trips HBM.
+
+Every op here is int32 ALU with exact wraparound semantics, so the kernel
+is **bit-identical** to the lax schedules by integer associativity; the
+decision parity vs the host oracle is preserved exactly (the parity fuzz
+suite in ``tests/test_pallas_scan.py`` stamps this, not approximates it).
+
+Escape hatches / fallback:
+
+* ``TEXTBLAST_PALLAS=off`` (or the older ``TEXTBLAST_NO_PALLAS=1``)
+  disables every Pallas kernel — callers fall back to the lax scans.
+* Non-TPU backends fall back automatically.  ``TEXTBLAST_PALLAS_INTERPRET=1``
+  forces the interpret-mode kernel anywhere — how the fuzz suite runs the
+  exact kernel program under tier-1 on CPU.
+* Mosaic ``pallas_call`` custom calls carry no GSPMD partitioning rule, so
+  a program jitted with multi-device shardings cannot contain a bare one.
+  ``CompiledPipeline`` traces mesh programs under :func:`mesh_tracing`,
+  which turns these kernels off for that trace — the lax scans partition
+  fine under GSPMD (the sort kernel shard_maps instead; the scans keep
+  scope and simply fall back).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import logging
+import threading
+from typing import Callable, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .pallas_sort import ROWS, interpret_forced, pallas_enabled, pltpu, roll_lanes
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "affine_hash_scan",
+    "dfa_compose_scan",
+    "mesh_tracing",
+    "pallas_scan_ok",
+    "pallas_scan_supported",
+]
+
+#: Lanes per in-kernel scan block.  Blocked doubling costs
+#: ``L/BLK * (log2(BLK)+1)`` roll+compose levels vs ``L * log2(L)`` for a
+#: whole-row scan — 512 keeps the working set one register-friendly tile
+#: while shaving the upper doubling levels of long buckets.
+_BLK = 512
+
+_MAX_LANES = 65536  # beyond this the [8, L] tile no longer fits VMEM comfortably
+
+_tls = threading.local()
+
+
+@contextlib.contextmanager
+def mesh_tracing(active: bool = True):
+    """Mark the current (thread-local) trace as targeting a multi-device
+    sharded program, where a bare ``pallas_call`` is illegal (no GSPMD
+    rule).  ``pallas_scan_supported`` returns False inside this context."""
+    prev = getattr(_tls, "mesh_tracing", False)
+    _tls.mesh_tracing = bool(active)
+    try:
+        yield
+    finally:
+        _tls.mesh_tracing = prev
+
+
+def _mesh_trace_active() -> bool:
+    return bool(getattr(_tls, "mesh_tracing", False))
+
+
+def _blk_for(length: int) -> int:
+    for blk in (_BLK, 256, 128):
+        if length % blk == 0:
+            return blk
+    raise ValueError(f"row length {length} is not a multiple of 128")
+
+
+def _scan_body(op: Callable, identities: Sequence[int], refs) -> None:
+    """Kernel body: blocked inclusive scan of an n-stream int32 tuple state
+    along the lane axis, one VMEM-resident row tile per grid step."""
+    n = len(refs) // 2
+    in_refs, out_refs = refs[:n], refs[n:]
+    rows, length = in_refs[0].shape
+    blk = _blk_for(length)
+    # In-kernel lane index (Pallas kernels cannot capture host constants).
+    lane = jax.lax.broadcasted_iota(jnp.int32, (rows, blk), 1)
+    idents = tuple(jnp.int32(i) for i in identities)
+
+    def body(i, carry):
+        start = i * blk
+        xs = tuple(r[:, pl.ds(start, blk)] for r in in_refs)
+        d = 1
+        while d < blk:
+            # Hillis–Steele level: acc[j] = op(acc[j-d], acc[j]).  The roll
+            # is circular; wrapped lanes are masked to the op identity.
+            shifted = tuple(
+                jnp.where(lane >= d, roll_lanes(x, d), ident)
+                for x, ident in zip(xs, idents)
+            )
+            xs = op(shifted, xs)
+            d *= 2
+        # Fold the running prefix of all earlier blocks ([rows, 1],
+        # broadcast) in front of this block's inclusive scan.
+        xs = op(carry, xs)
+        for r, x in zip(out_refs, xs):
+            r[:, pl.ds(start, blk)] = x
+        return tuple(x[:, blk - 1 : blk] for x in xs)
+
+    init = tuple(jnp.full((rows, 1), i, jnp.int32) for i in identities)
+    jax.lax.fori_loop(0, length // blk, body, init)
+
+
+def _pallas_scan_tuple(
+    op: Callable,
+    identities: Sequence[int],
+    xs: Tuple[jax.Array, ...],
+    interpret: bool,
+) -> Tuple[jax.Array, ...]:
+    """Row-wise inclusive associative scan of int32 ``[B, L]`` streams.
+    ``op`` maps (earlier-tuple, later-tuple) -> tuple with elementwise jnp
+    ops only (operands may broadcast ``[B, 1]`` against ``[B, blk]``)."""
+    n = len(xs)
+    b, length = xs[0].shape
+
+    def kernel(*refs):
+        _scan_body(op, identities, refs)
+
+    spec = pl.BlockSpec((ROWS, length), lambda i: (i, 0))
+    shape = jax.ShapeDtypeStruct((b, length), jnp.int32)
+    return pl.pallas_call(
+        kernel,
+        grid=(b // ROWS,),
+        in_specs=[spec] * n,
+        out_specs=[spec] * n,
+        out_shape=[shape] * n,
+        interpret=interpret,
+    )(*(x.astype(jnp.int32) for x in xs))
+
+
+# --- associative ops (must match the lax twins bit-for-bit) -----------------
+
+
+def _affine_op(xs, ys):
+    # Segmented polynomial hash: affine maps h -> m*h + a, composed
+    # earlier-then-later; identical to stats._poly_hash_many's compose.
+    mx, axs = xs[0], xs[1:]
+    my, ays = ys[0], ys[1:]
+    return (mx * my,) + tuple(ay + my * ax for ax, ay in zip(axs, ays))
+
+
+def _dfa_op(n_states: int) -> Callable:
+    def op(xs, ys):
+        # (b . a)(s) = b[a[s]]: route each of a's nibbles through b —
+        # identical to dfa.dfa_states's compose.
+        a, b = xs[0], ys[0]
+        out = None
+        for s in range(n_states):
+            nib = (a >> (4 * s)) & 15
+            term = ((b >> (nib << 2)) & 15) << (4 * s)
+            out = term if out is None else out | term
+        return (out,)
+
+    return op
+
+
+def _dfa_ident(n_states: int) -> int:
+    ident = 0
+    for s in range(n_states):
+        ident |= s << (4 * s)
+    return ident
+
+
+# --- support gates ----------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=1)
+def _probe_backend() -> bool:
+    """Compile and run one tiny kernel on the live backend, checking it
+    against the lax result — Mosaic availability differs per
+    backend/runtime version and a failed probe must mean fallback, not a
+    crashed pipeline."""
+    if pltpu is None or jax.default_backend() == "cpu":
+        return False
+    try:
+        m = jnp.full((ROWS, 128), 31, jnp.int32)
+        a = (jax.lax.broadcasted_iota(jnp.int32, (ROWS, 128), 1) * 7) % 97
+        got = _pallas_scan_tuple(_affine_op, (1, 0), (m, a), interpret=False)
+        want = jax.lax.associative_scan(_affine_op, (m, a), axis=1)
+        ok = all(bool(jnp.array_equal(g, w)) for g, w in zip(got, want))
+        if not ok:  # pragma: no cover - would be a Mosaic miscompile
+            logger.warning("Pallas scan probe mismatch; using lax scans")
+        return ok
+    except Exception as e:  # pragma: no cover - backend-specific
+        logger.warning("Pallas scan unavailable on %s: %s", jax.default_backend(), e)
+        return False
+
+
+def pallas_scan_supported() -> bool:
+    """Whether the scan kernels can run here.  Env decisions are re-read per
+    call (only the backend probe is cached); always False while tracing a
+    mesh-sharded program (see :func:`mesh_tracing`)."""
+    if not pallas_enabled():
+        return False
+    if _mesh_trace_active():
+        return False
+    if interpret_forced():
+        return True
+    return _probe_backend()
+
+
+def pallas_scan_ok(b: int, length: int) -> bool:
+    """Shape + support gate callers use before dispatching to a kernel."""
+    return (
+        pallas_scan_supported()
+        and b > 0
+        and b % ROWS == 0
+        and 128 <= length <= _MAX_LANES
+        and length % 128 == 0
+    )
+
+
+# --- public kernels ---------------------------------------------------------
+
+
+def dfa_compose_scan(fns: jax.Array, n_states: int) -> jax.Array:
+    """Inclusive scan of nibble-packed DFA transition maps along axis 1 —
+    the kernel twin of ``dfa.dfa_states``'s <=8-state composition.  Callers
+    gate on :func:`pallas_scan_ok` first."""
+    (out,) = _pallas_scan_tuple(
+        _dfa_op(n_states),
+        (_dfa_ident(n_states),),
+        (fns,),
+        interpret=interpret_forced(),
+    )
+    return out
+
+
+def affine_hash_scan(
+    m: jax.Array, accs: Tuple[jax.Array, ...]
+) -> Tuple[jax.Array, ...]:
+    """Inclusive scan of the shared-multiplier affine hash op — the kernel
+    twin of ``stats._poly_hash_many``.  Returns the scanned accumulator
+    streams (the scanned multiplier is internal).  Callers gate on
+    :func:`pallas_scan_ok` first."""
+    identities = (1,) + (0,) * len(accs)
+    out = _pallas_scan_tuple(
+        _affine_op, identities, (m,) + tuple(accs), interpret=interpret_forced()
+    )
+    return out[1:]

@@ -56,17 +56,45 @@ except ImportError:  # pragma: no cover
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["sort2", "sort3", "pallas_sort2", "pallas_sort3", "pallas_sort_supported"]
+__all__ = [
+    "sort2",
+    "sort3",
+    "pallas_sort2",
+    "pallas_sort3",
+    "pallas_sort_supported",
+    # Shared Pallas helpers (used by ops.pallas_scan as well).
+    "ROWS",
+    "interpret_forced",
+    "pallas_enabled",
+    "roll_lanes",
+]
 
 _ROWS = 8  # sublane tile for int32
+ROWS = _ROWS
 
 #: Mesh axis the batch dimension is sharded over (parallel.mesh.DATA_AXIS;
 #: duplicated here to keep this module importable standalone).
 _DATA_AXIS = "data"
 
 
-def _interpret_forced() -> bool:
+def pallas_enabled() -> bool:
+    """Global Pallas escape hatch shared by every kernel (sort + scan):
+    ``TEXTBLAST_PALLAS=off`` (or ``0``/``false``) and the older
+    ``TEXTBLAST_NO_PALLAS=1`` both force the lax fallbacks everywhere.
+    Re-read per call so tests can toggle it."""
+    if os.environ.get("TEXTBLAST_PALLAS", "").lower() in ("off", "0", "false"):
+        return False
+    if os.environ.get("TEXTBLAST_NO_PALLAS"):
+        return False
+    return True
+
+
+def interpret_forced() -> bool:
     return bool(os.environ.get("TEXTBLAST_PALLAS_INTERPRET"))
+
+
+# Back-compat internal alias (older call sites / tests).
+_interpret_forced = interpret_forced
 
 
 def _lex_gt(a: Tuple[jax.Array, ...], b: Tuple[jax.Array, ...]) -> jax.Array:
@@ -77,12 +105,17 @@ def _lex_gt(a: Tuple[jax.Array, ...], b: Tuple[jax.Array, ...]) -> jax.Array:
     return gt
 
 
-def _roll(k: jax.Array, shift: int) -> jax.Array:
+def roll_lanes(k: jax.Array, shift: int) -> jax.Array:
+    """Circular right-roll along the lane axis.  ``pltpu.roll`` requires
+    non-negative shifts; callers spell a left-roll by ``s`` as a right-roll
+    by ``lanes - s``.  Works under interpret mode too (generic lowering ==
+    ``jnp.roll``), so CPU tests run the exact kernel program the TPU lowers."""
     if pltpu is not None:
-        # Works under interpret mode too (generic lowering == jnp.roll), so
-        # the CPU-mesh tests run the same kernel program the TPU lowers.
         return pltpu.roll(k, shift=shift, axis=1)
     return jnp.roll(k, shift, axis=1)  # pragma: no cover - pltpu unavailable
+
+
+_roll = roll_lanes
 
 
 def _bitonic_kernel(*refs):
@@ -175,7 +208,7 @@ def pallas_sort_supported() -> bool:
     re-read on every call (only the backend lowering probe is cached), so a
     test or embedder toggling the env vars cannot be poisoned by a stale
     cached answer."""
-    if os.environ.get("TEXTBLAST_NO_PALLAS"):
+    if not pallas_enabled():
         return False
     if _interpret_forced():
         return True

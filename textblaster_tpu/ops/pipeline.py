@@ -22,7 +22,9 @@ the host oracle — the outlier path SURVEY.md §5 calls for.
 
 from __future__ import annotations
 
+import dataclasses
 import logging
+import os
 import time as _time_mod
 from collections import deque
 from functools import lru_cache, partial
@@ -310,6 +312,87 @@ def _split_phases(steps: List[StepConfig]) -> List[List[int]]:
     return phases or [[]]
 
 
+@dataclasses.dataclass
+class WarmupStats:
+    """Timing breakdown of one ``warmup_parallel`` call.
+
+    ``total_s`` is wall time; ``trace_s``/``compile_s``/``cache_load_s``
+    attribute where it went (compile_s is summed across pool threads, so it
+    can exceed total_s on multi-core).  ``float(stats)`` is ``total_s`` for
+    drop-in use where the old float return was consumed."""
+
+    total_s: float = 0.0
+    trace_s: float = 0.0
+    compile_s: float = 0.0
+    cache_load_s: float = 0.0
+    programs: int = 0
+    cache_hits: int = 0
+    cache_misses: int = 0
+    cache_stores: int = 0
+
+    def __float__(self) -> float:
+        return self.total_s
+
+    def to_dict(self) -> Dict[str, float]:
+        return dataclasses.asdict(self)
+
+
+def _toggle_xla_compilation_cache(on: bool) -> bool:
+    """Flip ``jax_enable_compilation_cache`` and force jax to notice.
+
+    jax memoizes ``is_cache_used()`` (module globals ``_cache_checked`` /
+    ``_cache_used``) the first time any compile consults the cache, so a
+    plain config update after that point is silently ignored.
+    ``reset_cache()`` clears the memo.  Returns True iff the flag changed."""
+    try:
+        if bool(jax.config.jax_enable_compilation_cache) == on:
+            return False
+        jax.config.update("jax_enable_compilation_cache", on)
+    except AttributeError:  # pragma: no cover - very old jax
+        return False
+    try:
+        from jax._src import compilation_cache as _xla_cc
+
+        _xla_cc.reset_cache()
+    except Exception:  # pragma: no cover - private API drift
+        pass
+    return True
+
+
+def should_warmup(warmup: Optional[bool] = None) -> bool:
+    """Resolve the warmup tri-state: explicit flag > ``TEXTBLAST_WARMUP``
+    env > backend default (accelerators warm — cold remote compiles
+    dominate startup; CPU stays lazy — first-dispatch compiles there are
+    cheap and a warm AOT cache makes them cheaper)."""
+    if warmup is not None:
+        return warmup
+    env = os.environ.get("TEXTBLAST_WARMUP", "").lower()
+    if env:
+        return env not in ("0", "off", "false")
+    return jax.default_backend() in ("tpu", "axon")
+
+
+def maybe_warmup(
+    pipeline: "CompiledPipeline", warmup: Optional[bool] = None
+) -> Optional[WarmupStats]:
+    """Warm ``pipeline`` when the resolved policy says so (see
+    :func:`should_warmup`); every runner entry point (streaming,
+    checkpointed, multi-host) funnels through this so the AOT executable
+    cache is consulted uniformly.  Returns the stats, or None if skipped."""
+    if pipeline.fully_host or not pipeline.device_steps:
+        return None
+    if not should_warmup(warmup):
+        return None
+    ws = pipeline.warmup_parallel()
+    logger.info(
+        "warmup: %d programs in %.2fs (trace %.2fs, compile %.2fs, "
+        "cache-load %.2fs, %d/%d AOT hits)",
+        ws.programs, ws.total_s, ws.trace_s, ws.compile_s,
+        ws.cache_load_s, ws.cache_hits, ws.programs,
+    )
+    return ws
+
+
 class CompiledPipeline:
     """A pipeline config compiled for device execution."""
 
@@ -531,6 +614,17 @@ class CompiledPipeline:
         )
 
         def fn(cps, lengths):
+            if self.mesh is not None:
+                # Bare pallas_call has no GSPMD rule: tracing under
+                # mesh_tracing() makes the scan kernels decline, so multi-
+                # device programs get the lax scans (which partition fine).
+                from .pallas_scan import mesh_tracing
+
+                with mesh_tracing():
+                    return inner(cps, lengths)
+            return inner(cps, lengths)
+
+        def inner(cps, lengths):
             if self.wire_u16:
                 # Wire is uint16; every kernel computes in int32.  The widen
                 # fuses into the first consumer on device.
@@ -635,51 +729,144 @@ class CompiledPipeline:
             self._jitted[key] = self._build_fn(length, phase)
         return self._jitted[key]
 
-    def warmup_parallel(self, max_workers: int = 8) -> float:
-        """AOT-compile every (bucket, phase) program concurrently.
+    def _warmup_jobs(self, include_split_rows: bool = True):
+        """``(program key, length, phase, rows)`` tuples warmup must cover:
+        every (bucket, phase) at geometry rows — plus the degradation
+        ladder's half-split row count, which ``_execute_packed`` packs both
+        halves to and ``_fn_for`` keys separately.  Without pre-seeding,
+        those programs always compiled cold *mid-incident*, stacking a
+        15-29 s compile stall on top of whatever fault tripped the split."""
+        jobs = []
+        for length in self.buckets:
+            full = self.geometry.batch_for(length)
+            variants = [full]
+            sub = (full + 1) // 2
+            if (
+                include_split_rows
+                and self._split_retry
+                and self.mesh is None
+                and sub != full
+            ):
+                variants.append(sub)
+            for phase in range(len(self.phases)):
+                for rows in variants:
+                    key = (length, phase) if rows == full else (length, phase, rows)
+                    jobs.append((key, length, phase, rows))
+        return jobs
 
-        Tracing is Python (GIL-bound) but XLA compilation releases the GIL —
-        and on the remote-tunnel TPU backend the compile happens on the far
-        side, so N in-flight compiles cost ~the slowest one instead of the
-        sum (the round-3 cold bench spent 459s compiling programs one at a
-        time).  Compiled executables are installed in the same program cache
-        ``dispatch_batch`` uses.  Returns wall seconds spent.
+    def warmup_parallel(
+        self,
+        max_workers: int = 8,
+        aot_cache=None,
+        include_split_rows: bool = True,
+    ) -> "WarmupStats":
+        """Install every warmup program (see ``_warmup_jobs``), cheapest
+        source first: serialized AOT executable cache, else trace + compile.
 
-        Tracing happens serially up front (cheap, single-core) so the pool
-        only runs the GIL-releasing ``lower().compile()`` calls.
+        **AOT cache.**  Each program is first looked up in the serialized
+        executable store (``utils.compile_cache.AOTExecutableCache``),
+        keyed by geometry + filter-config fingerprints, jax version,
+        backend, topology, shape, and the trace-shaping env knobs.  A hit
+        deserializes a finished executable — no trace, no lower, no
+        compile — so a warm start loads every (bucket, phase) program in
+        well under a second instead of the 15-29 s cold path.  Misses are
+        compiled and stored back.  ``TEXTBLAST_NO_COMPILE_CACHE=1``
+        bypasses both directions; pass ``aot_cache`` to use a specific
+        store (bench A/B, tests).
 
-        On accelerator backends each thread also fires ONE throwaway
-        execution of its freshly compiled program (zero-filled batch):
-        the first dispatch of an executable pays a load/setup cost the
-        compile does not (measured on the round-5 TPU window: c4's
-        ``warmup_s`` was 97 s against ``warmup_compile_s`` 25.6 — ~4.8 s
-        x 15 programs of first-dispatch overhead landing in the first warm
-        pass).  Doing it here overlaps those loads across the pool.  CPU
-        backends skip it: there is no remote load to hide and a full-batch
-        execution costs real pass time.
+        **Compile pool.**  Tracing is Python (GIL-bound) and happens
+        serially up front; XLA compilation releases the GIL — and on the
+        remote-tunnel TPU backend happens on the far side — so N in-flight
+        compiles cost ~the slowest one instead of the sum (the round-3
+        cold bench spent 459 s compiling programs one at a time).
+
+        On accelerator backends each pool thread also fires ONE throwaway
+        execution of its program (zero-filled batch): the first dispatch
+        pays a load/setup cost the compile does not (round-5 TPU window:
+        ``warmup_s`` 97 s vs ``warmup_compile_s`` 25.6 — ~4.8 s x 15
+        programs of first-dispatch overhead).  CPU backends skip it: no
+        remote load to hide, and a full-batch execution costs real pass
+        time.
+
+        Returns a :class:`WarmupStats` breakdown (``float()`` of it is
+        total wall seconds).
         """
         import time as _time
         from concurrent.futures import ThreadPoolExecutor
+        from threading import Lock
 
         import numpy as _np
 
-        import jax.numpy as jnp
+        from ..utils.compile_cache import (
+            AOTExecutableCache,
+            config_fingerprint,
+            program_cache_key,
+        )
 
-        warm_dispatch = self.mesh is None and jax.default_backend() != "cpu"
-
+        stats = WarmupStats()
         t0 = _time.perf_counter()
-        jobs = []
-        for length in self.buckets:
-            for phase in range(len(self.phases)):
-                key = (length, phase)
-                if key in self._jitted and not hasattr(self._jitted[key], "lower"):
-                    continue  # already AOT-compiled
-                fn = self._fn_for(length, phase)
-                wire = jnp.uint16 if self.wire_u16 else jnp.int32
-                rows = self.geometry.batch_for(length)
-                cps = jax.ShapeDtypeStruct((rows, length), wire)
-                lens = jax.ShapeDtypeStruct((rows,), jnp.int32)
-                jobs.append((key, fn.lower(cps, lens)))
+        warm_dispatch = self.mesh is None and jax.default_backend() != "cpu"
+        wire = jnp.uint16 if self.wire_u16 else jnp.int32
+        wire_name = "uint16" if self.wire_u16 else "int32"
+        backend = jax.default_backend()
+        n_devices = self.mesh.devices.size if self.mesh is not None else 1
+
+        cache = aot_cache if aot_cache is not None else AOTExecutableCache()
+        try:
+            cfg_fp = config_fingerprint(self.config)
+            geo_fp = self.geometry.fingerprint()
+        except Exception as e:  # pragma: no cover - exotic config objects
+            logger.warning("AOT cache disabled (unfingerprintable): %s", e)
+            cache = None
+
+        def cache_key(length, phase, rows):
+            return program_cache_key(
+                config_fp=cfg_fp,
+                geometry_fp=geo_fp,
+                backend=backend,
+                length=length,
+                phase=phase,
+                rows=rows,
+                wire=wire_name,
+                n_devices=n_devices,
+                mesh=self.mesh is not None,
+            )
+
+        # Serial front half: AOT-cache loads, then traces for the misses.
+        to_compile = []  # (key, length, rows, lowered, aot_key)
+        loaded = []  # (key, length, rows, compiled) — warm-dispatch only
+        for key, length, phase, rows in self._warmup_jobs(include_split_rows):
+            if key in self._jitted and not hasattr(self._jitted[key], "lower"):
+                continue  # already an installed executable
+            stats.programs += 1
+            aot_key = None
+            if cache is not None:
+                aot_key = cache_key(length, phase, rows)
+                t = _time.perf_counter()
+                compiled = cache.load(aot_key)
+                stats.cache_load_s += _time.perf_counter() - t
+                if compiled is not None:
+                    stats.cache_hits += 1
+                    self._jitted[key] = compiled
+                    if warm_dispatch:
+                        loaded.append((key, length, rows, compiled))
+                    continue
+                stats.cache_misses += 1
+            fn = self._fn_for(length, phase, rows=rows)
+            cps = jax.ShapeDtypeStruct((rows, length), wire)
+            lens = jax.ShapeDtypeStruct((rows,), jnp.int32)
+            t = _time.perf_counter()
+            lowered = fn.lower(cps, lens)
+            stats.trace_s += _time.perf_counter() - t
+            to_compile.append((key, length, rows, lowered, aot_key))
+
+        lock = Lock()
+
+        def dispatch_zero(compiled, length, rows):
+            wire_np = _np.uint16 if self.wire_u16 else _np.int32
+            z = jnp.asarray(_np.zeros((rows, length), dtype=wire_np))
+            zl = jnp.asarray(_np.zeros((rows,), dtype=_np.int32))
+            jax.block_until_ready(compiled(z, zl))
 
         def compile_one(item):
             # The remote-tunnel compile service drops connections under load
@@ -689,8 +876,9 @@ class CompiledPipeline:
             # re-issue the compile; the lowered IR is reusable.  Genuine
             # compile errors (shape/VMEM) repeat identically and surface on
             # the final attempt.
-            key, lowered = item
+            key, length, rows, lowered, aot_key = item
             last = None
+            t = _time.perf_counter()
             for attempt in range(4):
                 try:
                     compiled = lowered.compile()
@@ -701,19 +889,42 @@ class CompiledPipeline:
                         _time.sleep(2.0 * (attempt + 1))
             else:
                 raise last
+            with lock:
+                stats.compile_s += _time.perf_counter() - t
+            if cache is not None and aot_key is not None:
+                if cache.store(aot_key, compiled):
+                    with lock:
+                        stats.cache_stores += 1
             if warm_dispatch:
-                length = key[0]
-                rows = self.geometry.batch_for(length)
-                wire_np = _np.uint16 if self.wire_u16 else _np.int32
-                z = jnp.asarray(_np.zeros((rows, length), dtype=wire_np))
-                zl = jnp.asarray(_np.zeros((rows,), dtype=_np.int32))
-                jax.block_until_ready(compiled(z, zl))
+                dispatch_zero(compiled, length, rows)
             return key, compiled
 
-        with ThreadPoolExecutor(max_workers=max_workers) as pool:
-            for key, compiled in pool.map(compile_one, jobs):
-                self._jitted[key] = compiled
-        return _time.perf_counter() - t0
+        def load_one(item):
+            key, length, rows, compiled = item
+            dispatch_zero(compiled, length, rows)
+
+        # Compiles that will be stored must NOT be served by XLA's own
+        # persistent compilation cache: cache-served executables serialize
+        # without their kernel object code (deserialize fails "Symbols not
+        # found" on XLA:CPU), so the AOT store would fill with entries every
+        # future process evicts.  Flipping the enable flag alone is not
+        # enough — jax memoizes is_cache_used() at first compile — so the
+        # memo must be reset around the toggle.  Nothing else compiles
+        # during warmup; everything is restored before the first dispatch.
+        xla_cache_disabled = False
+        if cache is not None and to_compile:
+            xla_cache_disabled = _toggle_xla_compilation_cache(False)
+        try:
+            with ThreadPoolExecutor(max_workers=max_workers) as pool:
+                if loaded:
+                    list(pool.map(load_one, loaded))
+                for key, compiled in pool.map(compile_one, to_compile):
+                    self._jitted[key] = compiled
+        finally:
+            if xla_cache_disabled:
+                _toggle_xla_compilation_cache(True)
+        stats.total_s = _time.perf_counter() - t0
+        return stats
 
     # --- host finalizers ----------------------------------------------------
     #
@@ -1825,6 +2036,7 @@ def process_documents_device(
     mesh=None,
     pipeline: Optional[CompiledPipeline] = None,
     geometry: Optional[DeviceGeometry] = None,
+    warmup: Optional[bool] = None,
 ) -> Iterator[ProcessingOutcome]:
     """Device-backed processing loop: packs the stream into bucketed batches,
     runs the compiled pipeline, assembles outcomes in input order per batch.
@@ -1848,14 +2060,11 @@ def process_documents_device(
             mesh=mesh,
             geometry=geometry,
         )
-        if pipeline.device_steps and not pipeline.fully_host and jax.default_backend() in (
-            "tpu",
-            "axon",
-        ):
-            # Remote/TPU compiles are the dominant cold-start cost and run
-            # serially if left to first dispatch; compile everything
-            # concurrently up front (warm cache makes this near-free).
-            pipeline.warmup_parallel()
+        # Remote/TPU compiles are the dominant cold-start cost and run
+        # serially if left to first dispatch; compile everything concurrently
+        # up front — a populated AOT executable cache makes this a sub-second
+        # load instead of a 15-29 s compile.
+        maybe_warmup(pipeline, warmup)
 
     if pipeline.fully_host or not pipeline.device_steps:
         if pipeline.device_steps and pipeline.fully_host:

@@ -122,6 +122,12 @@ def _poly_hash_many(
         return (mx * my,) + tuple(ay + my * ax for ax, ay in zip(axs, ays))
 
     from .device import _scan_impl, chunk_scan_tuple, shift_scan_tuple
+    from .pallas_scan import affine_hash_scan, pallas_scan_ok
+
+    if pallas_scan_ok(*m.shape):
+        # Blocked VMEM kernel — same int32 affine composition, bit-identical
+        # to every lax schedule below (parity fuzzed in tests).
+        return affine_hash_scan(m, accs)
 
     impl = _scan_impl()
     if impl != "assoc":

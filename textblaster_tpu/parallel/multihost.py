@@ -624,7 +624,7 @@ def run_local_shard(
     one 1-int allgather per round resolution — the fault-free program
     sequence is unchanged.
     """
-    from ..ops.pipeline import CompiledPipeline, record_occupancy
+    from ..ops.pipeline import CompiledPipeline, maybe_warmup, record_occupancy
     from ..orchestration import execute_processing_pipeline
     from ..resilience.negotiated import NegotiatedGuard
     from ..resilience.retry import classify_error
@@ -642,6 +642,11 @@ def run_local_shard(
     n_proc = len({d.process_index for d in mesh.devices.flat})
     if pipeline is None:
         pipeline = CompiledPipeline(config, buckets=buckets, mesh=mesh)
+        # Warm before the first lockstep round: every host compiles (or AOT-
+        # cache-loads) the identical program set up front, so no host hits a
+        # first-dispatch compile stall mid-round while its peers wait at the
+        # allgather.
+        maybe_warmup(pipeline)
     # Per-bucket local row counts: each host feeds its 1/n_proc stripe of the
     # bucket's global batch.  Under uniform geometry every bucket resolves to
     # the old single ``pipeline.batch_size // n_proc``.
@@ -1053,6 +1058,11 @@ def run_multihost(
             config, buckets=tuple(sorted(buckets)), batch_size=device_batch,
             mesh=mesh, geometry=geometry,
         )
+        from ..ops.pipeline import maybe_warmup
+
+        # Warm ahead of the lockstep rounds (see run_local_shard): compile
+        # stalls must not land mid-round where peers wait at the allgather.
+        maybe_warmup(pipeline)
         try:
             outcomes = run_local_shard(
                 config, docs, buckets=pipeline.geometry.buckets, mesh=mesh,
@@ -1310,6 +1320,12 @@ def _run_elastic(
         config, buckets=tuple(sorted(buckets)), batch_size=device_batch,
         mesh=mesh,
     )
+    from ..ops.pipeline import maybe_warmup
+
+    # Warm (or AOT-cache-load) the program set before claiming a stripe —
+    # a restarted-in-place elastic member re-enters with warm executables
+    # instead of re-paying the cold compile inside its adopted stripe.
+    maybe_warmup(pipeline)
 
     n_rows = pq.ParquetFile(input_file).metadata.num_rows
     stride = math.ceil(n_rows / max(num_processes, 1))

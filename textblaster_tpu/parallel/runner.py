@@ -86,6 +86,7 @@ def run_pipeline(
     auto_geometry: bool = False,
     quiet: bool = False,
     errors_file: Optional[str] = None,
+    warmup: Optional[bool] = None,
 ) -> AggregationResult:
     progress = _Progress(enabled=not quiet)
     read_errors = [0]
@@ -181,6 +182,7 @@ def run_pipeline(
                 on_read_error=on_read_error,
                 mesh=mesh,
                 geometry=geometry,
+                warmup=warmup,
                 **kwargs,
             )
         else:

@@ -1,24 +1,67 @@
-"""Persistent XLA compilation cache setup.
+"""Persistent compilation caches.
 
-The filter-pipeline programs are large graphs (every filter traced into one
-``jit`` per shape bucket), and remote TPU compiles through the axon tunnel
-take minutes; a persistent on-disk cache makes repeat runs (tests, the
-driver's bench, CLI re-invocations) near-instant.  Shared by ``bench.py``,
-``tests/conftest.py``, and the CLI.
+Two layers, both rooted in the repo-local (gitignored) ``.cache/``:
+
+1. **XLA's built-in compilation cache** (:func:`enable_compilation_cache`)
+   — skips the XLA *compile*, but every process still pays trace + lower
+   per program (~seconds each for the fused filter graphs).
+2. **Serialized AOT executable store** (:class:`AOTExecutableCache`) —
+   pickles ``jax.experimental.serialize_executable.serialize()`` payloads
+   per program, keyed by everything that shapes the traced computation
+   (geometry + filter-config fingerprints, jax/jaxlib versions, backend,
+   device topology, program shape, trace-shaping env knobs, and a
+   content hash of this package's sources).  A warm start loads finished
+   executables and skips trace, lower, *and* compile —
+   ``CompiledPipeline.warmup_parallel`` consults it first.
+
+``TEXTBLAST_NO_COMPILE_CACHE=1`` bypasses both layers (measurement escape
+hatch: cache-loaded XLA:CPU executables can differ in performance from the
+in-memory JIT result of a fresh compile).
+
+Entries that fail to unpickle or to deserialize (corrupt, truncated, or
+written by an incompatible runtime that slipped past the key) are evicted
+and silently recompiled — a cache problem must never take down a run.
+The store is size-capped (``TEXTBLAST_AOT_CACHE_MB``, default 512) with
+least-recently-*used* eviction: loads touch the entry's mtime.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import functools
+import hashlib
+import json
+import logging
 import os
+import pickle
+import tempfile
+from typing import Any, Dict, Optional
 
-__all__ = ["enable_compilation_cache", "DEFAULT_CACHE_DIR"]
+logger = logging.getLogger(__name__)
 
-#: Repo-local cache directory (gitignored).
-DEFAULT_CACHE_DIR = os.path.join(
+__all__ = [
+    "enable_compilation_cache",
+    "DEFAULT_CACHE_DIR",
+    "DEFAULT_AOT_DIR",
+    "AOTExecutableCache",
+    "aot_cache_enabled",
+    "aot_cache_supported",
+    "config_fingerprint",
+    "program_cache_key",
+]
+
+_CACHE_ROOT = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
     ".cache",
-    "jax",
 )
+
+#: XLA compilation-cache directory (gitignored).
+DEFAULT_CACHE_DIR = os.path.join(_CACHE_ROOT, "jax")
+
+#: Serialized-executable store directory (gitignored).
+DEFAULT_AOT_DIR = os.path.join(_CACHE_ROOT, "aot")
+
+_SUFFIX = ".aotx"
 
 
 def enable_compilation_cache(cache_dir: str | None = None) -> str:
@@ -37,3 +80,264 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str:
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     return cache_dir
+
+
+def aot_cache_enabled() -> bool:
+    """The executable store honors the same bypass as the XLA cache."""
+    return os.environ.get("TEXTBLAST_NO_COMPILE_CACHE") != "1"
+
+
+@functools.lru_cache(maxsize=1)
+def aot_cache_supported() -> bool:
+    """Whether the installed jax has the AOT serialization API.
+
+    ``jax.export`` only round-trips StableHLO — the importer still pays a
+    full XLA compile, which is the cost this cache exists to skip —
+    so the *executable*-level ``serialize_executable`` API is required."""
+    try:
+        from jax.experimental.serialize_executable import (  # noqa: F401
+            deserialize_and_load,
+            serialize,
+        )
+
+        return True
+    except Exception:  # pragma: no cover - older/partial jax builds
+        return False
+
+
+# --- cache keys -------------------------------------------------------------
+
+
+def config_fingerprint(config: Any) -> str:
+    """Filter-config fingerprint: step types + params as stable JSON (the
+    same recipe the checkpoint manifest uses, re-implemented here so the
+    cache layer stays import-light)."""
+    steps = getattr(config, "pipeline", config)
+    blob = json.dumps(
+        [{"type": s.type, "params": dataclasses.asdict(s.params)} for s in steps],
+        sort_keys=True,
+        default=str,
+    )
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:16]
+
+
+#: Env knobs that change the *traced program* (scan schedule, table impl,
+#: wire dtype, phase layout, Pallas kernel selection).  Two processes whose
+#: knobs differ must never share an executable.
+_TRACE_ENV_KNOBS = (
+    "TEXTBLAST_SCAN_IMPL",
+    "TEXTBLAST_TABLE_IMPL",
+    "TEXTBLAST_WIRE",
+    "TEXTBLAST_PHASES",
+    "TEXTBLAST_PALLAS",
+    "TEXTBLAST_NO_PALLAS",
+    "TEXTBLAST_PALLAS_INTERPRET",
+)
+
+
+@functools.lru_cache(maxsize=1)
+def _code_fingerprint() -> str:
+    """Content hash of this package's sources.  The traced program changes
+    whenever the kernels change; jax/config versioning alone would happily
+    serve an executable compiled from last week's code."""
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    h = hashlib.sha256()
+    for root, dirs, files in sorted(os.walk(pkg_dir)):
+        dirs.sort()
+        for name in sorted(files):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(root, name)
+            h.update(os.path.relpath(path, pkg_dir).encode("utf-8"))
+            try:
+                with open(path, "rb") as f:
+                    h.update(f.read())
+            except OSError:  # pragma: no cover - racing an editor
+                continue
+    return h.hexdigest()[:16]
+
+
+def program_cache_key(
+    *,
+    config_fp: str,
+    geometry_fp: str,
+    backend: str,
+    length: int,
+    phase: int,
+    rows: int,
+    wire: str,
+    n_devices: int = 1,
+    mesh: bool = False,
+    extra: Optional[Dict[str, Any]] = None,
+) -> str:
+    """Stable key for one compiled program.  Everything that shapes the
+    trace or the executable's validity participates; any mismatch is a
+    cache miss, never a wrong program."""
+    import jax
+
+    try:
+        import jaxlib
+
+        jaxlib_version = getattr(jaxlib, "__version__", "?")
+    except Exception:  # pragma: no cover
+        jaxlib_version = "?"
+    parts = {
+        "code": _code_fingerprint(),
+        "config": config_fp,
+        "geometry": geometry_fp,
+        "jax": jax.__version__,
+        "jaxlib": jaxlib_version,
+        "backend": backend,
+        "n_devices": n_devices,
+        "mesh": bool(mesh),
+        "processes": jax.process_count(),
+        "length": length,
+        "phase": phase,
+        "rows": rows,
+        "wire": wire,
+        "x64": bool(jax.config.jax_enable_x64),
+        "env": {k: os.environ.get(k, "") for k in _TRACE_ENV_KNOBS},
+    }
+    if extra:
+        parts["extra"] = extra
+    blob = json.dumps(parts, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:32]
+
+
+# --- the store --------------------------------------------------------------
+
+
+def _unlink_quiet(path: str) -> None:
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
+class AOTExecutableCache:
+    """On-disk store of serialized compiled executables.
+
+    ``load``/``store`` never raise for cache-side problems: a missing,
+    corrupt, or incompatible entry is a miss (and is evicted), a failed
+    write is a warning.  Writes are atomic (tmp + rename) so concurrent
+    warmup threads and sibling processes can share the directory."""
+
+    def __init__(
+        self, cache_dir: Optional[str] = None, max_bytes: Optional[int] = None
+    ) -> None:
+        self.cache_dir = (
+            cache_dir
+            or os.environ.get("TEXTBLAST_AOT_CACHE_DIR")
+            or DEFAULT_AOT_DIR
+        )
+        if max_bytes is None:
+            max_bytes = int(
+                float(os.environ.get("TEXTBLAST_AOT_CACHE_MB", "512")) * 1_000_000
+            )
+        self.max_bytes = max_bytes
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.cache_dir, key + _SUFFIX)
+
+    def load(self, key: str):
+        """Return the deserialized executable for ``key``, or None on any
+        miss (absent, bypassed, unsupported, corrupt — the latter evicted)."""
+        if not (aot_cache_enabled() and aot_cache_supported()):
+            return None
+        path = self._path(key)
+        try:
+            with open(path, "rb") as f:
+                payload, in_tree, out_tree = pickle.load(f)
+        except FileNotFoundError:
+            return None
+        except Exception as e:  # corrupt / truncated / wrong pickle
+            logger.warning("evicting corrupt AOT cache entry %s: %s", key, e)
+            _unlink_quiet(path)
+            return None
+        try:
+            from jax.experimental.serialize_executable import deserialize_and_load
+
+            compiled = deserialize_and_load(payload, in_tree, out_tree)
+        except Exception as e:  # runtime/topology mismatch that beat the key
+            logger.warning("evicting unloadable AOT cache entry %s: %s", key, e)
+            _unlink_quiet(path)
+            return None
+        try:
+            os.utime(path, None)  # LRU recency
+        except OSError:  # pragma: no cover
+            pass
+        return compiled
+
+    def store(self, key: str, compiled) -> bool:
+        """Serialize ``compiled`` under ``key``; returns True on success.
+        Backends whose executables do not serialize simply decline."""
+        if not (aot_cache_enabled() and aot_cache_supported()):
+            return False
+        try:
+            from jax.experimental.serialize_executable import (
+                deserialize_and_load,
+                serialize,
+            )
+
+            payload, in_tree, out_tree = serialize(compiled)
+            # Validate before writing: executables XLA served from its own
+            # persistent compilation cache serialize without their kernel
+            # object code ("Symbols not found" on load, XLA:CPU) — a store
+            # that every future process would evict is worse than no store.
+            deserialize_and_load(payload, in_tree, out_tree)
+            blob = pickle.dumps((payload, in_tree, out_tree))
+        except Exception as e:
+            logger.debug("AOT serialize declined for %s: %s", key, e)
+            return False
+        try:
+            os.makedirs(self.cache_dir, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=self.cache_dir, suffix=".tmp")
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    f.write(blob)
+                os.replace(tmp, self._path(key))
+            finally:
+                if os.path.exists(tmp):  # replace failed
+                    _unlink_quiet(tmp)
+        except OSError as e:  # pragma: no cover - disk full etc.
+            logger.warning("AOT cache write failed for %s: %s", key, e)
+            return False
+        self._evict_lru()
+        return True
+
+    def _entries(self):
+        try:
+            names = os.listdir(self.cache_dir)
+        except OSError:
+            return []
+        out = []
+        for name in names:
+            if not name.endswith(_SUFFIX):
+                continue
+            path = os.path.join(self.cache_dir, name)
+            try:
+                st = os.stat(path)
+            except OSError:  # racing another evictor
+                continue
+            out.append((st.st_mtime, st.st_size, path))
+        return out
+
+    def _evict_lru(self) -> int:
+        """Drop least-recently-used entries until under the size cap.
+        Returns the number evicted."""
+        entries = self._entries()
+        total = sum(size for _, size, _ in entries)
+        evicted = 0
+        for _, size, path in sorted(entries):
+            if total <= self.max_bytes:
+                break
+            _unlink_quiet(path)
+            total -= size
+            evicted += 1
+        if evicted:
+            logger.info("AOT cache evicted %d entr%s (size cap %d bytes)",
+                        evicted, "y" if evicted == 1 else "ies", self.max_bytes)
+        return evicted
+
+    def size_bytes(self) -> int:
+        return sum(size for _, size, _ in self._entries())
