@@ -829,18 +829,18 @@ def main() -> int:
     # the kernels are an execution-schedule change, never a semantic one.  On
     # XLA:CPU both sides trace the same lax programs (kernels auto-decline),
     # so the A/B doubles as the no-regression check there.
+    def _kernel_pass(p):
+        run = [d.copy() for d in docs]
+        t0 = time.perf_counter()
+        outs = list(
+            process_documents_device(config, iter(run), pipeline=p)
+        )
+        return len(outs) / (time.perf_counter() - t0), outs
+
     pallas_report = None
     if os.environ.get("BENCH_PALLAS", "1") != "0":
         from textblaster_tpu.ops.pallas_scan import pallas_scan_supported
         from textblaster_tpu.ops.pallas_sort import pallas_sort_supported
-
-        def _kernel_pass(p):
-            run = [d.copy() for d in docs]
-            t0 = time.perf_counter()
-            outs = list(
-                process_documents_device(config, iter(run), pipeline=p)
-            )
-            return len(outs) / (time.perf_counter() - t0), outs
 
         try:
             scan_active = pallas_scan_supported()
@@ -887,6 +887,98 @@ def main() -> int:
         except Exception as e:  # never bill a kernel A/B problem to the bench
             pallas_report = {"error": str(e)}
             _log(f"pallas A/B skipped: {e}")
+
+    # --- Fused megakernel on/off A/B (BENCH_FUSED=0 skips).  A fresh
+    # pipeline traced under TEXTBLAST_FUSED=off runs the staged per-scan
+    # path (individual Pallas kernels where supported, else lax); the
+    # default pipeline fuses each (bucket, phase)'s filter scans into one
+    # pallas_call.  Same three-way contract as the pallas A/B: decisions
+    # byte-identical fused vs staged vs host oracle.  On XLA:CPU both
+    # timed arms trace the same lax programs (kernels auto-decline), so
+    # the dispatch counts below are taken at *trace* level under
+    # TEXTBLAST_PALLAS_INTERPRET=1 — jax.eval_shape only, no execution —
+    # which is where the fused-vs-staged structural difference lives.
+    fused_report = None
+    if os.environ.get("BENCH_FUSED", "1") != "0":
+        from textblaster_tpu.ops.pallas_scan import fused_enabled
+
+        try:
+            prev_fused = os.environ.get("TEXTBLAST_FUSED")
+            os.environ["TEXTBLAST_FUSED"] = "off"
+            try:
+                p_nf = CompiledPipeline(
+                    config,
+                    buckets=bench_buckets,
+                    batch_size=device_batch,
+                    geometry=geometry,
+                )
+                p_nf.warmup_parallel()
+                _kernel_pass(p_nf)  # untimed warm pass
+                nf_rate, nf_out = _kernel_pass(p_nf)
+            finally:
+                if prev_fused is None:
+                    os.environ.pop("TEXTBLAST_FUSED", None)
+                else:
+                    os.environ["TEXTBLAST_FUSED"] = prev_fused
+            f_rate, f_out = _kernel_pass(pipeline)
+            f_by_id = {o.document.id: o.kind for o in f_out}
+            nf_by_id = {o.document.id: o.kind for o in nf_out}
+            three_way = sum(
+                1
+                for k, v in host_by_id.items()
+                if f_by_id.get(k) == v and nf_by_id.get(k) == v
+            ) / max(len(host_by_id), 1)
+
+            # Per-(bucket, phase) scan dispatch counts, both arms.
+            dispatches = {}
+            tot_on = tot_off = 0
+            prev_int = os.environ.get("TEXTBLAST_PALLAS_INTERPRET")
+            os.environ["TEXTBLAST_PALLAS_INTERPRET"] = "1"
+            try:
+                for length in pipeline.geometry.buckets:
+                    for phase in range(len(pipeline.phases)):
+                        on_c = pipeline.scan_dispatch_counts(length, phase)
+                        prev2 = os.environ.get("TEXTBLAST_FUSED")
+                        os.environ["TEXTBLAST_FUSED"] = "off"
+                        try:
+                            off_c = pipeline.scan_dispatch_counts(
+                                length, phase
+                            )
+                        finally:
+                            if prev2 is None:
+                                os.environ.pop("TEXTBLAST_FUSED", None)
+                            else:
+                                os.environ["TEXTBLAST_FUSED"] = prev2
+                        tot_on += sum(on_c.values())
+                        tot_off += sum(off_c.values())
+                        dispatches[f"{length}/p{phase}"] = {
+                            "fused": on_c,
+                            "staged": off_c,
+                        }
+            finally:
+                if prev_int is None:
+                    os.environ.pop("TEXTBLAST_PALLAS_INTERPRET", None)
+                else:
+                    os.environ["TEXTBLAST_PALLAS_INTERPRET"] = prev_int
+            fused_report = {
+                "fused_enabled": fused_enabled(),
+                "on_docs_per_sec": round(f_rate, 2),
+                "off_docs_per_sec": round(nf_rate, 2),
+                "speedup": round(f_rate / nf_rate, 4),
+                "parity_on_off_host": round(three_way, 6),
+                "scan_dispatches_on": tot_on,
+                "scan_dispatches_off": tot_off,
+                "scan_dispatches": dispatches,
+            }
+            _log(
+                f"fused A/B: {f_rate:.1f} docs/s on vs {nf_rate:.1f} off "
+                f"(x{fused_report['speedup']}, dispatches {tot_on} vs "
+                f"{tot_off}, 3-way parity {three_way:.4f})"
+            )
+            del p_nf
+        except Exception as e:  # never bill a kernel A/B problem to the bench
+            fused_report = {"error": str(e)}
+            _log(f"fused A/B skipped: {e}")
 
     # --- Negotiated fault-guard overhead, fault-free (BENCH_RESILIENCE=0
     # skips).  The multi-host lockstep rounds run under the negotiated guard
@@ -1066,6 +1158,10 @@ def main() -> int:
         # Pallas kernel on/off A/B + three-way decision parity
         # (kernels-on vs kernels-off vs host oracle).
         **({"pallas": pallas_report} if pallas_report else {}),
+        # Fused megakernel on/off A/B: docs/s, three-way parity, and
+        # per-(bucket, phase) scan dispatch counts (trace-level, counted
+        # under interpret so the structural reduction shows on any backend).
+        **({"fused": fused_report} if fused_report else {}),
         # Per-stage wall seconds across the 3 timed passes + the host-bound
         # vs device-bound verdict (stages overlap, so the sum can exceed
         # wall time; compare stages to each other).

@@ -305,6 +305,12 @@ def chunk_scan_tuple(op, identities, xs, axis: int = 1, chunk_size: int = 0):
 
 
 def _seg_scan(op, identity, values: jax.Array, reset: jax.Array, axis: int):
+    # Dispatch accounting for bench's fused-vs-staged A/B (no-op unless a
+    # count_scan_dispatches scope is active).  Imported lazily: device is
+    # imported by pallas_scan's consumers, never the other way around.
+    from .pallas_scan import record_scan_dispatch
+
+    record_scan_dispatch("lax_scan")
     impl = _scan_impl()
     if impl == "shift":
         # Virtual elements left of position 0 are (op identity, reset=True):
@@ -331,6 +337,9 @@ def assoc_scan1(op, identity, x: jax.Array, axis: int = 1) -> jax.Array:
     ``identity`` is ``op``'s identity: a scalar, or an array broadcastable to
     a ``[B, d, ...]`` pad block (e.g. an iota for function-composition scans).
     """
+    from .pallas_scan import record_scan_dispatch
+
+    record_scan_dispatch("lax_scan")
     impl = _scan_impl()
     if impl == "assoc":
         return jax.lax.associative_scan(op, x, axis=axis)

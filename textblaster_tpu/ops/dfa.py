@@ -60,7 +60,9 @@ def dfa_states(
             ident |= s << (4 * s)
         if pallas_scan_ok(*fns.shape):
             # Blocked VMEM kernel — same int32 composition, bit-identical
-            # (pallas_scan module docstring; parity fuzzed in tests).
+            # (pallas_scan module docstring; parity fuzzed in tests).  Under
+            # mesh_tracing(mesh) the kernel dispatch shard_maps itself over
+            # the data axis, so mesh programs keep this path too.
             packed = dfa_compose_scan(fns, n_states)
         else:
             packed = assoc_scan1(compose, np.int32(ident), fns, axis=1)

@@ -1,4 +1,5 @@
-"""VMEM-resident blocked associative scans (DFA composition, rolling hashes).
+"""VMEM-resident blocked associative scans (DFA composition, rolling hashes)
+and the fused per-bucket filter megakernel.
 
 The per-row hot scans — DFA matching over nibble-packed transition maps
 (:mod:`.dfa`) and the segmented polynomial-hash streams feeding the
@@ -12,24 +13,39 @@ Hillis–Steele doubling (circular lane rolls masked to the op identity) and
 folding a per-row carry across blocks — intermediate state never
 round-trips HBM.
 
-Every op here is int32 ALU with exact wraparound semantics, so the kernel
-is **bit-identical** to the lax schedules by integer associativity; the
+:func:`fused_scan` goes one step further: it lowers *several* independent
+scan groups (affine hash streams, segmented adds, DFA compositions, and
+whole-row reductions) into ONE ``pallas_call`` that walks the packed
+codepoint tile once — each lane block is loaded once and every group's
+doubling runs on it in-register, so a phase's worth of filter statistics
+costs one kernel dispatch per (bucket, phase) instead of one per scan, and
+no intermediate mask or stat stream touches HBM between filters.  Groups
+marked ``emit="last"`` write only their final ``[B, 1]`` carry (a per-row
+total), never the full scanned stream.
+
+Every op here is int32 ALU with exact wraparound semantics, so the kernels
+are **bit-identical** to the lax schedules by integer associativity; the
 decision parity vs the host oracle is preserved exactly (the parity fuzz
-suite in ``tests/test_pallas_scan.py`` stamps this, not approximates it).
+suites in ``tests/test_pallas_scan.py`` and ``tests/test_fused_scan.py``
+stamp this, not approximate it).
 
 Escape hatches / fallback:
 
 * ``TEXTBLAST_PALLAS=off`` (or the older ``TEXTBLAST_NO_PALLAS=1``)
   disables every Pallas kernel — callers fall back to the lax scans.
+* ``TEXTBLAST_FUSED=off`` disables only the fused megakernel — the
+  per-scan kernels (and their lax fallbacks) still run.
 * Non-TPU backends fall back automatically.  ``TEXTBLAST_PALLAS_INTERPRET=1``
   forces the interpret-mode kernel anywhere — how the fuzz suite runs the
   exact kernel program under tier-1 on CPU.
 * Mosaic ``pallas_call`` custom calls carry no GSPMD partitioning rule, so
   a program jitted with multi-device shardings cannot contain a bare one.
-  ``CompiledPipeline`` traces mesh programs under :func:`mesh_tracing`,
-  which turns these kernels off for that trace — the lax scans partition
-  fine under GSPMD (the sort kernel shard_maps instead; the scans keep
-  scope and simply fall back).
+  ``CompiledPipeline`` traces mesh programs under ``mesh_tracing(mesh)``,
+  which makes every scan here dispatch through ``shard_map`` over the data
+  axis instead (mirroring ``pallas_sort.sort2``) — rows are independent, so
+  each device scans its own row shard in VMEM and mesh-sharded programs no
+  longer fall back to the lax scans.  The legacy ``mesh_tracing()`` form
+  (no mesh object) still declines the kernels outright.
 """
 
 from __future__ import annotations
@@ -37,23 +53,40 @@ from __future__ import annotations
 import contextlib
 import functools
 import logging
+import os
 import threading
-from typing import Callable, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.sharding import Mesh, PartitionSpec as P
 
-from .pallas_sort import ROWS, interpret_forced, pallas_enabled, pltpu, roll_lanes
+from .pallas_sort import (
+    ROWS,
+    interpret_forced,
+    pallas_enabled,
+    pltpu,
+    roll_lanes,
+    shard_map,
+)
 
 logger = logging.getLogger(__name__)
 
 __all__ = [
+    "add_group",
+    "affine_group",
     "affine_hash_scan",
+    "count_scan_dispatches",
     "dfa_compose_scan",
+    "dfa_group",
+    "fused_enabled",
+    "fused_scan",
+    "fused_scan_ok",
     "mesh_tracing",
     "pallas_scan_ok",
     "pallas_scan_supported",
+    "record_scan_dispatch",
 ]
 
 #: Lanes per in-kernel scan block.  Blocked doubling costs
@@ -64,24 +97,94 @@ _BLK = 512
 
 _MAX_LANES = 65536  # beyond this the [8, L] tile no longer fits VMEM comfortably
 
+#: The fused kernel holds every group's input *and* output tiles resident at
+#: once, so its lane ceiling is tighter than the 2–4-stream per-scan kernels.
+_FUSED_MAX_LANES = 16384
+
+#: Mesh axis the batch dimension is sharded over (parallel.mesh.DATA_AXIS;
+#: duplicated here to keep this module importable standalone).
+_DATA_AXIS = "data"
+
 _tls = threading.local()
 
 
 @contextlib.contextmanager
-def mesh_tracing(active: bool = True):
+def mesh_tracing(mesh=True):
     """Mark the current (thread-local) trace as targeting a multi-device
     sharded program, where a bare ``pallas_call`` is illegal (no GSPMD
-    rule).  ``pallas_scan_supported`` returns False inside this context."""
-    prev = getattr(_tls, "mesh_tracing", False)
-    _tls.mesh_tracing = bool(active)
+    rule).
+
+    Pass the program's :class:`~jax.sharding.Mesh` and every scan kernel in
+    this module dispatches through ``shard_map`` over the data axis — each
+    device scans its own row shard in VMEM (the ``pallas_sort.sort2``
+    pattern).  The legacy forms keep working: ``mesh_tracing()`` / ``True``
+    declines the kernels for the scope (no mesh to shard_map over), and
+    ``mesh_tracing(False)`` re-enables bare kernels inside an active scope.
+    """
+    prev = getattr(_tls, "mesh", False)
+    _tls.mesh = mesh
     try:
         yield
     finally:
-        _tls.mesh_tracing = prev
+        _tls.mesh = prev
 
 
-def _mesh_trace_active() -> bool:
-    return bool(getattr(_tls, "mesh_tracing", False))
+def _mesh_shards() -> Optional[int]:
+    """How many data-axis shards the current trace's rows split into.
+
+    1 outside ``mesh_tracing`` (bare kernels are fine); the data-axis size
+    under ``mesh_tracing(mesh)``; None when kernels must decline — the
+    legacy ``mesh_tracing()`` marker, or a mesh without a usable data axis
+    (callers then take the lax scans, which partition fine under GSPMD)."""
+    state = getattr(_tls, "mesh", False)
+    if state is False or state is None:
+        return 1
+    if state is True:
+        return None
+    size = dict(state.shape).get(_DATA_AXIS)
+    if size == 1 and state.devices.size > 1:
+        return None
+    return size
+
+
+def _current_mesh() -> Optional[Mesh]:
+    """The mesh to shard_map kernels over, or None for a bare kernel."""
+    state = getattr(_tls, "mesh", False)
+    if isinstance(state, Mesh):
+        shards = _mesh_shards()
+        if shards is not None and shards > 1:
+            return state
+    return None
+
+
+# --- dispatch accounting ----------------------------------------------------
+#
+# bench.py's BENCH_FUSED A/B counts how many scan dispatches one traced
+# (bucket, phase) program issues — the figure the fused kernel exists to
+# shrink.  Recording is thread-local and a no-op unless a
+# count_scan_dispatches() scope is active, so the hot path pays one getattr.
+
+
+def record_scan_dispatch(kind: str) -> None:
+    """Count one scan dispatch of ``kind`` ("fused", "pallas_scan",
+    "lax_scan") if a :func:`count_scan_dispatches` scope is active."""
+    counts = getattr(_tls, "dispatch_counts", None)
+    if counts is not None:
+        counts[kind] = counts.get(kind, 0) + 1
+
+
+@contextlib.contextmanager
+def count_scan_dispatches():
+    """Collect per-kind scan dispatch counts issued while tracing under this
+    scope (trace-time accounting: each recorded dispatch is one device
+    kernel/scan in the lowered program)."""
+    prev = getattr(_tls, "dispatch_counts", None)
+    counts: Dict[str, int] = {}
+    _tls.dispatch_counts = counts
+    try:
+        yield counts
+    finally:
+        _tls.dispatch_counts = prev
 
 
 def _blk_for(length: int) -> int:
@@ -143,14 +246,16 @@ def _pallas_scan_tuple(
 
     spec = pl.BlockSpec((ROWS, length), lambda i: (i, 0))
     shape = jax.ShapeDtypeStruct((b, length), jnp.int32)
-    return pl.pallas_call(
-        kernel,
-        grid=(b // ROWS,),
-        in_specs=[spec] * n,
-        out_specs=[spec] * n,
-        out_shape=[shape] * n,
-        interpret=interpret,
-    )(*(x.astype(jnp.int32) for x in xs))
+    return tuple(
+        pl.pallas_call(
+            kernel,
+            grid=(b // ROWS,),
+            in_specs=[spec] * n,
+            out_specs=[spec] * n,
+            out_shape=[shape] * n,
+            interpret=interpret,
+        )(*(x.astype(jnp.int32) for x in xs))
+    )
 
 
 # --- associative ops (must match the lax twins bit-for-bit) -----------------
@@ -162,6 +267,12 @@ def _affine_op(xs, ys):
     mx, axs = xs[0], xs[1:]
     my, ays = ys[0], ys[1:]
     return (mx * my,) + tuple(ay + my * ax for ax, ay in zip(axs, ays))
+
+
+def _add_op(xs, ys):
+    # Plain elementwise sum streams — exact by integer associativity, used
+    # both for cumulative counts and (emit="last") whole-row totals.
+    return tuple(x + y for x, y in zip(xs, ys))
 
 
 def _dfa_op(n_states: int) -> Callable:
@@ -186,38 +297,270 @@ def _dfa_ident(n_states: int) -> int:
     return ident
 
 
+# --- fused multi-group megakernel -------------------------------------------
+#
+# A "group" is one independent associative scan over one or more int32
+# [B, L] streams.  fused_scan() lowers a list of groups into a single
+# pallas_call whose body walks each lane block once and runs every group's
+# Hillis–Steele doubling on the in-register tile — so a phase's statistics
+# cost one dispatch, and streams a caller only needs reduced (emit="last")
+# never touch HBM at full width.
+
+
+def affine_group(
+    m: jax.Array, accs: Sequence[jax.Array], emit: str = "scan"
+) -> dict:
+    """Shared-multiplier segmented affine-hash group (the fused twin of
+    :func:`affine_hash_scan`).  Emits only the accumulator streams — the
+    scanned multiplier stays in-register."""
+    return {"kind": "affine", "xs": (m,) + tuple(accs), "emit": emit}
+
+
+def add_group(vals: Sequence[jax.Array], emit: str = "scan") -> dict:
+    """Elementwise running-sum group.  ``emit="last"`` yields ``[B, 1]``
+    whole-row totals (the fused twin of ``jnp.sum(..., axis=1)``)."""
+    return {"kind": "add", "xs": tuple(vals), "emit": emit}
+
+
+def dfa_group(fns: jax.Array, n_states: int, emit: str = "scan") -> dict:
+    """Nibble-packed DFA transition-map composition group (the fused twin of
+    :func:`dfa_compose_scan`)."""
+    return {"kind": "dfa", "xs": (fns,), "emit": emit, "n_states": n_states}
+
+
+def _group_spec(g: dict) -> Tuple[Callable, Tuple[int, ...], int, Tuple[int, ...], bool]:
+    """(op, identities, n_inputs, emitted stream indices, emit_last)."""
+    kind = g["kind"]
+    n_in = len(g["xs"])
+    emit = g.get("emit", "scan")
+    if emit not in ("scan", "last"):
+        raise ValueError(f"unknown emit mode {emit!r}")
+    emit_last = emit == "last"
+    if kind == "affine":
+        return _affine_op, (1,) + (0,) * (n_in - 1), n_in, tuple(range(1, n_in)), emit_last
+    if kind == "add":
+        return _add_op, (0,) * n_in, n_in, tuple(range(n_in)), emit_last
+    if kind == "dfa":
+        n_states = g["n_states"]
+        return _dfa_op(n_states), (_dfa_ident(n_states),), 1, (0,), emit_last
+    raise ValueError(f"unknown fused group kind {kind!r}")
+
+
+def _fused_body(specs, refs) -> None:
+    """Kernel body: one pass over the row tile's lane blocks, every group's
+    blocked doubling + carry fold run on each in-register block."""
+    n_in_total = sum(s[2] for s in specs)
+    in_refs, out_refs = refs[:n_in_total], refs[n_in_total:]
+    rows, length = in_refs[0].shape
+    blk = _blk_for(length)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (rows, blk), 1)
+
+    # Static partition of the flat ref lists back into per-group views.
+    group_in, group_out = [], []
+    i = j = 0
+    for _, _, n_in, emit_idx, _ in specs:
+        group_in.append(in_refs[i : i + n_in])
+        i += n_in
+        group_out.append(out_refs[j : j + len(emit_idx)])
+        j += len(emit_idx)
+
+    def body(b_i, carry):
+        start = b_i * blk
+        new_carry = []
+        for g, (op, identities, _, emit_idx, emit_last) in enumerate(specs):
+            xs = tuple(r[:, pl.ds(start, blk)] for r in group_in[g])
+            idents = tuple(jnp.int32(v) for v in identities)
+            d = 1
+            while d < blk:
+                shifted = tuple(
+                    jnp.where(lane >= d, roll_lanes(x, d), ident)
+                    for x, ident in zip(xs, idents)
+                )
+                xs = op(shifted, xs)
+                d *= 2
+            xs = op(carry[g], xs)
+            if not emit_last:
+                for r, x_idx in zip(group_out[g], emit_idx):
+                    r[:, pl.ds(start, blk)] = xs[x_idx]
+            new_carry.append(tuple(x[:, blk - 1 : blk] for x in xs))
+        return tuple(new_carry)
+
+    init = tuple(
+        tuple(jnp.full((rows, 1), v, jnp.int32) for v in s[1]) for s in specs
+    )
+    final = jax.lax.fori_loop(0, length // blk, body, init)
+    for g, (_, _, _, emit_idx, emit_last) in enumerate(specs):
+        if emit_last:
+            for r, x_idx in zip(group_out[g], emit_idx):
+                r[:, :] = final[g][x_idx]
+
+
+def _fused_call(groups: Sequence[dict], interpret: bool) -> Tuple[jax.Array, ...]:
+    """One pallas_call evaluating every group; returns the flat tuple of
+    emitted streams in group order."""
+    specs = tuple(_group_spec(g) for g in groups)
+    xs = tuple(x for g in groups for x in g["xs"])
+    b, length = xs[0].shape
+
+    def kernel(*refs):
+        _fused_body(specs, refs)
+
+    row_spec = pl.BlockSpec((ROWS, length), lambda i: (i, 0))
+    last_spec = pl.BlockSpec((ROWS, 1), lambda i: (i, 0))
+    out_specs: List[pl.BlockSpec] = []
+    out_shapes: List[jax.ShapeDtypeStruct] = []
+    for _, _, _, emit_idx, emit_last in specs:
+        for _ in emit_idx:
+            out_specs.append(last_spec if emit_last else row_spec)
+            out_shapes.append(
+                jax.ShapeDtypeStruct((b, 1) if emit_last else (b, length), jnp.int32)
+            )
+    return tuple(
+        pl.pallas_call(
+            kernel,
+            grid=(b // ROWS,),
+            in_specs=[row_spec] * len(xs),
+            out_specs=out_specs,
+            out_shape=out_shapes,
+            interpret=interpret,
+        )(*(x.astype(jnp.int32) for x in xs))
+    )
+
+
+def _regroup(groups: Sequence[dict], flat: Sequence[jax.Array]):
+    """Split a flat emitted-stream tuple back into per-group tuples."""
+    out, i = [], 0
+    for g in groups:
+        k = len(_group_spec(g)[3])
+        out.append(tuple(flat[i : i + k]))
+        i += k
+    return out
+
+
+# --- shard_map dispatch -----------------------------------------------------
+
+
+def _shard_mapped(fn: Callable, mesh: Mesh, xs: Tuple[jax.Array, ...], n_out: int):
+    """Run ``fn`` (a bare pallas scan over the local row shard) under
+    shard_map, rows sharded along the data axis — the pallas_sort._sharded_sort
+    pattern.  Rows are independent, so no collective is inserted."""
+    spec = P(_DATA_AXIS, None)
+    kwargs = dict(mesh=mesh, in_specs=(spec,) * len(xs), out_specs=(spec,) * n_out)
+    try:
+        # Replication checking needs vma annotations pallas outputs don't
+        # carry; rows are fully sharded, nothing is replicated — disable it.
+        mapped = shard_map(fn, check_vma=False, **kwargs)
+    except TypeError:  # pre-vma JAX spells it check_rep
+        mapped = shard_map(fn, check_rep=False, **kwargs)
+    return mapped(*xs)
+
+
+def _dispatch_scan_tuple(
+    op: Callable, identities: Sequence[int], xs: Tuple[jax.Array, ...]
+) -> Tuple[jax.Array, ...]:
+    """Mesh-aware dispatch for the per-scan kernels: bare pallas_call on a
+    single device, shard_map'd over the data axis under ``mesh_tracing(mesh)``.
+    Callers gate on :func:`pallas_scan_ok` first."""
+    record_scan_dispatch("pallas_scan")
+    interpret = interpret_forced()
+    mesh = _current_mesh()
+    if mesh is not None:
+        def fn(*ks):
+            return _pallas_scan_tuple(op, identities, ks, interpret)
+
+        return tuple(_shard_mapped(fn, mesh, tuple(xs), len(xs)))
+    return _pallas_scan_tuple(op, identities, tuple(xs), interpret)
+
+
 # --- support gates ----------------------------------------------------------
 
 
-@functools.lru_cache(maxsize=1)
-def _probe_backend() -> bool:
+def _env_hatches() -> Tuple[str, ...]:
+    """The env hatches that shape a probe verdict.  Probe caches key on
+    these so flipping a hatch mid-process (as tests do) re-probes instead of
+    serving the verdict cached under the old env."""
+    return (
+        os.environ.get("TEXTBLAST_PALLAS", ""),
+        os.environ.get("TEXTBLAST_NO_PALLAS", ""),
+        os.environ.get("TEXTBLAST_PALLAS_INTERPRET", ""),
+        os.environ.get("TEXTBLAST_FUSED", ""),
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _probe_cached(env: Tuple[str, ...], backend: str) -> bool:
     """Compile and run one tiny kernel on the live backend, checking it
     against the lax result — Mosaic availability differs per
     backend/runtime version and a failed probe must mean fallback, not a
     crashed pipeline."""
-    if pltpu is None or jax.default_backend() == "cpu":
+    del env  # participates only in the cache key
+    if pltpu is None or backend == "cpu":
         return False
     try:
-        m = jnp.full((ROWS, 128), 31, jnp.int32)
-        a = (jax.lax.broadcasted_iota(jnp.int32, (ROWS, 128), 1) * 7) % 97
-        got = _pallas_scan_tuple(_affine_op, (1, 0), (m, a), interpret=False)
-        want = jax.lax.associative_scan(_affine_op, (m, a), axis=1)
-        ok = all(bool(jnp.array_equal(g, w)) for g, w in zip(got, want))
+        with jax.ensure_compile_time_eval():
+            m = jnp.full((ROWS, 128), 31, jnp.int32)
+            a = (jax.lax.broadcasted_iota(jnp.int32, (ROWS, 128), 1) * 7) % 97
+            got = _pallas_scan_tuple(_affine_op, (1, 0), (m, a), interpret=False)
+            want = jax.lax.associative_scan(_affine_op, (m, a), axis=1)
+            ok = all(bool(jnp.array_equal(g, w)) for g, w in zip(got, want))
         if not ok:  # pragma: no cover - would be a Mosaic miscompile
             logger.warning("Pallas scan probe mismatch; using lax scans")
         return ok
     except Exception as e:  # pragma: no cover - backend-specific
-        logger.warning("Pallas scan unavailable on %s: %s", jax.default_backend(), e)
+        logger.warning("Pallas scan unavailable on %s: %s", backend, e)
         return False
+
+
+def _probe_backend() -> bool:
+    return _probe_cached(_env_hatches(), jax.default_backend())
+
+
+@functools.lru_cache(maxsize=32)
+def _probe_fused_cached(env: Tuple[str, ...], backend: str) -> bool:
+    """Probe the fused megakernel specifically: its emit="last" outputs use
+    a narrower BlockSpec the per-scan probe never exercises."""
+    del env
+    if pltpu is None or backend == "cpu":
+        return False
+    try:
+        with jax.ensure_compile_time_eval():
+            m = jnp.full((ROWS, 128), 31, jnp.int32)
+            a = (jax.lax.broadcasted_iota(jnp.int32, (ROWS, 128), 1) * 7) % 97
+            ones = jnp.ones((ROWS, 128), jnp.int32)
+            got = _fused_call(
+                [affine_group(m, (a,)), add_group((ones,), emit="last")],
+                interpret=False,
+            )
+            want_h = jax.lax.associative_scan(_affine_op, (m, a), axis=1)[1]
+            ok = bool(jnp.array_equal(got[0], want_h)) and bool(
+                jnp.array_equal(got[1], jnp.full((ROWS, 1), 128, jnp.int32))
+            )
+        if not ok:  # pragma: no cover - would be a Mosaic miscompile
+            logger.warning("fused scan probe mismatch; using staged scans")
+        return ok
+    except Exception as e:  # pragma: no cover - backend-specific
+        logger.warning("fused scan unavailable on %s: %s", backend, e)
+        return False
+
+
+def _probe_fused() -> bool:
+    return _probe_fused_cached(_env_hatches(), jax.default_backend())
+
+
+def fused_enabled() -> bool:
+    """``TEXTBLAST_FUSED=off`` (or ``0``/``false``) disables the fused
+    megakernel only; re-read per call so tests/benches can toggle it."""
+    return os.environ.get("TEXTBLAST_FUSED", "").lower() not in ("off", "0", "false")
 
 
 def pallas_scan_supported() -> bool:
     """Whether the scan kernels can run here.  Env decisions are re-read per
-    call (only the backend probe is cached); always False while tracing a
-    mesh-sharded program (see :func:`mesh_tracing`)."""
+    call (the backend probe is cached keyed on env hatches + backend);
+    False under the legacy mesh-marker trace or a mesh with no usable data
+    axis (see :func:`mesh_tracing` — a real mesh shard_maps instead)."""
     if not pallas_enabled():
         return False
-    if _mesh_trace_active():
+    if _mesh_shards() is None:
         return False
     if interpret_forced():
         return True
@@ -225,14 +568,32 @@ def pallas_scan_supported() -> bool:
 
 
 def pallas_scan_ok(b: int, length: int) -> bool:
-    """Shape + support gate callers use before dispatching to a kernel."""
+    """Shape + support gate callers use before dispatching to a kernel.
+    Under ``mesh_tracing(mesh)`` the row count must split evenly into
+    ROWS-aligned per-device shards (the shard_map'd kernel sees ``b/shards``
+    rows)."""
+    if not pallas_scan_supported():
+        return False
+    shards = _mesh_shards()
+    if shards is None or b <= 0 or b % shards:
+        return False
     return (
-        pallas_scan_supported()
-        and b > 0
-        and b % ROWS == 0
+        (b // shards) % ROWS == 0
         and 128 <= length <= _MAX_LANES
         and length % 128 == 0
     )
+
+
+def fused_scan_ok(b: int, length: int) -> bool:
+    """Gate for :func:`fused_scan` — the per-scan gate plus the fused
+    kernel's own hatch, probe, and tighter VMEM lane ceiling."""
+    if not fused_enabled():
+        return False
+    if not pallas_scan_ok(b, length):
+        return False
+    if length > _FUSED_MAX_LANES:
+        return False
+    return interpret_forced() or _probe_fused()
 
 
 # --- public kernels ---------------------------------------------------------
@@ -242,11 +603,8 @@ def dfa_compose_scan(fns: jax.Array, n_states: int) -> jax.Array:
     """Inclusive scan of nibble-packed DFA transition maps along axis 1 —
     the kernel twin of ``dfa.dfa_states``'s <=8-state composition.  Callers
     gate on :func:`pallas_scan_ok` first."""
-    (out,) = _pallas_scan_tuple(
-        _dfa_op(n_states),
-        (_dfa_ident(n_states),),
-        (fns,),
-        interpret=interpret_forced(),
+    (out,) = _dispatch_scan_tuple(
+        _dfa_op(n_states), (_dfa_ident(n_states),), (fns,)
     )
     return out
 
@@ -259,7 +617,32 @@ def affine_hash_scan(
     streams (the scanned multiplier is internal).  Callers gate on
     :func:`pallas_scan_ok` first."""
     identities = (1,) + (0,) * len(accs)
-    out = _pallas_scan_tuple(
-        _affine_op, identities, (m,) + tuple(accs), interpret=interpret_forced()
-    )
+    out = _dispatch_scan_tuple(_affine_op, identities, (m,) + tuple(accs))
     return out[1:]
+
+
+def fused_scan(groups: Sequence[dict]) -> List[Tuple[jax.Array, ...]]:
+    """Evaluate several independent scan groups in ONE kernel pass over the
+    row tile — see the module docstring.  Returns one tuple of emitted
+    int32 streams per group, in order: ``[B, L]`` scans for ``emit="scan"``
+    groups, ``[B, 1]`` per-row totals for ``emit="last"`` groups.  Callers
+    gate on :func:`fused_scan_ok` first."""
+    record_scan_dispatch("fused")
+    interpret = interpret_forced()
+    mesh = _current_mesh()
+    if mesh is not None:
+        xs = tuple(x for g in groups for x in g["xs"])
+        sizes = [len(g["xs"]) for g in groups]
+        n_out = sum(len(_group_spec(g)[3]) for g in groups)
+
+        def fn(*flat_xs):
+            local, i = [], 0
+            for g, n in zip(groups, sizes):
+                local.append(dict(g, xs=tuple(flat_xs[i : i + n])))
+                i += n
+            return _fused_call(local, interpret)
+
+        flat = tuple(_shard_mapped(fn, mesh, xs, n_out))
+    else:
+        flat = _fused_call(groups, interpret)
+    return _regroup(groups, flat)

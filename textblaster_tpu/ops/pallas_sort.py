@@ -67,6 +67,7 @@ __all__ = [
     "interpret_forced",
     "pallas_enabled",
     "roll_lanes",
+    "shard_map",
 ]
 
 _ROWS = 8  # sublane tile for int32
@@ -190,17 +191,34 @@ def pallas_sort2(
     return tuple(_pallas_sort_n((k1, k2), interpret=interpret))
 
 
-@functools.lru_cache(maxsize=1)
-def _probe_backend() -> bool:
-    if pltpu is None or jax.default_backend() == "cpu":
+def _env_hatches() -> Tuple[str, ...]:
+    """Env hatches that shape a probe verdict — the probe cache keys on
+    these so flipping a hatch mid-process (as tests do) re-probes instead
+    of serving the verdict cached under the old env."""
+    return (
+        os.environ.get("TEXTBLAST_PALLAS", ""),
+        os.environ.get("TEXTBLAST_NO_PALLAS", ""),
+        os.environ.get("TEXTBLAST_PALLAS_INTERPRET", ""),
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _probe_cached(env: Tuple[str, ...], backend: str) -> bool:
+    del env  # participates only in the cache key
+    if pltpu is None or backend == "cpu":
         return False
     try:
-        x = jnp.zeros((_ROWS, 128), jnp.int32)
-        jax.block_until_ready(pallas_sort3(x, x, x))
+        with jax.ensure_compile_time_eval():
+            x = jnp.zeros((_ROWS, 128), jnp.int32)
+            jax.block_until_ready(pallas_sort3(x, x, x))
         return True
     except Exception as e:  # pragma: no cover - backend-specific
-        logger.warning("Pallas sort unavailable on %s: %s", jax.default_backend(), e)
+        logger.warning("Pallas sort unavailable on %s: %s", backend, e)
         return False
+
+
+def _probe_backend() -> bool:
+    return _probe_cached(_env_hatches(), jax.default_backend())
 
 
 def pallas_sort_supported() -> bool:

@@ -548,7 +548,7 @@ class CompiledPipeline:
 
     # --- device program -----------------------------------------------------
 
-    def _build_fn(self, length: int, phase: int = 0) -> Callable:
+    def _build_fn(self, length: int, phase: int = 0, jit: bool = True) -> Callable:
         max_lines, max_words = _table_sizes(length)
         plans = []
         for i in self.phases[phase]:
@@ -616,11 +616,13 @@ class CompiledPipeline:
         def fn(cps, lengths):
             if self.mesh is not None:
                 # Bare pallas_call has no GSPMD rule: tracing under
-                # mesh_tracing() makes the scan kernels decline, so multi-
-                # device programs get the lax scans (which partition fine).
+                # mesh_tracing(mesh) makes every scan kernel dispatch through
+                # shard_map over the data axis instead (the pallas_sort.sort2
+                # pattern), so mesh programs keep the Pallas scans.  A mesh
+                # without a usable data axis still declines to the lax scans.
                 from .pallas_scan import mesh_tracing
 
-                with mesh_tracing():
+                with mesh_tracing(self.mesh):
                     return inner(cps, lengths)
             return inner(cps, lengths)
 
@@ -685,6 +687,10 @@ class CompiledPipeline:
                         out[f"{i}:hazard:{lang}"] = per_hazard[lang]
             return out
 
+        if not jit:
+            # Raw traceable fn (scan_dispatch_counts traces it under
+            # jax.eval_shape to count dispatches without compiling).
+            return fn
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
@@ -729,18 +735,51 @@ class CompiledPipeline:
             self._jitted[key] = self._build_fn(length, phase)
         return self._jitted[key]
 
+    def scan_dispatch_counts(
+        self, length: int, phase: int = 0, rows: Optional[int] = None
+    ) -> Dict[str, int]:
+        """Per-kind scan dispatch counts for one traced (bucket, phase)
+        program: "fused" / "pallas_scan" kernel calls and "lax_scan"
+        schedules.  Traces the raw program under ``jax.eval_shape`` (no
+        compile, no device execution), so bench's BENCH_FUSED A/B can report
+        how many scan dispatches the fused megakernel removed."""
+        from .pallas_scan import count_scan_dispatches
+
+        rows = rows or self.geometry.batch_for(length)
+        raw = self._build_fn(length, phase, jit=False)
+        wire = jnp.uint16 if self.wire_u16 else jnp.int32
+        cps = jax.ShapeDtypeStruct((rows, length), wire)
+        lens = jax.ShapeDtypeStruct((rows,), jnp.int32)
+        with count_scan_dispatches() as counts:
+            jax.eval_shape(raw, cps, lens)
+        return dict(counts)
+
+    @staticmethod
+    def _split_rows(full: int) -> int:
+        """Row count the degradation ladder's split rung packs each half to:
+        half the batch, rounded UP to the 8-row sublane tile so the split
+        program keeps the (fused) Pallas kernels — ``pallas_scan_ok`` /
+        ``fused_scan_ok`` require rows % 8 == 0, and pack_documents already
+        pads rows beyond the doc count."""
+        from .pallas_sort import ROWS
+
+        half = (full + 1) // 2
+        return min(full, ((half + ROWS - 1) // ROWS) * ROWS)
+
     def _warmup_jobs(self, include_split_rows: bool = True):
         """``(program key, length, phase, rows)`` tuples warmup must cover:
         every (bucket, phase) at geometry rows — plus the degradation
         ladder's half-split row count, which ``_execute_packed`` packs both
         halves to and ``_fn_for`` keys separately.  Without pre-seeding,
-        those programs always compiled cold *mid-incident*, stacking a
-        15-29 s compile stall on top of whatever fault tripped the split."""
+        those programs (fused-kernel variants included — the split rows are
+        ROWS-aligned via ``_split_rows`` so they trace the same fused path)
+        always compiled cold *mid-incident*, stacking a 15-29 s compile
+        stall on top of whatever fault tripped the split."""
         jobs = []
         for length in self.buckets:
             full = self.geometry.batch_for(length)
             variants = [full]
-            sub = (full + 1) // 2
+            sub = self._split_rows(full)
             if (
                 include_split_rows
                 and self._split_retry
@@ -1586,7 +1625,7 @@ class CompiledPipeline:
             TRACER.instant(
                 "ladder_split", {"bucket": batch.max_len, "phase": phase}
             )
-            sub_rows = (batch.batch_size + 1) // 2
+            sub_rows = self._split_rows(batch.batch_size)
             mid = (len(batch.docs) + 1) // 2
             for part in (batch.docs[:mid], batch.docs[mid:]):
                 if not part:

@@ -147,6 +147,52 @@ def _poly_hash(
     return _poly_hash_many((cps,), in_seg, seg_start, mul=mul)[0]
 
 
+# --- fused megakernel group builders -----------------------------------------
+# Twins of the staged scans above, expressed as pallas_scan.fused_scan groups
+# so several independent scans lower into ONE kernel pass over the row tile.
+# Each builder re-states the staged path's recurrence exactly:
+#
+# * segmented sum (device.seg_scan_add, monoid _seg_add_op) is the affine
+#   recurrence h = m*h_prev + v with m = 0 at segment resets, 1 elsewhere;
+# * the segmented polynomial hash is the same recurrence with m = mul inside
+#   segments (identical to _poly_hash_many's operand construction above).
+#
+# Both are int32 recurrences whose every schedule (lax shift/chunk/assoc,
+# per-scan kernel, fused kernel) computes the same function exactly, so the
+# fused path is bit-identical by integer associativity.  Callers gate on
+# pallas_scan.fused_scan_ok first.
+
+
+def _seg_add_group(values: Tuple[jax.Array, ...], reset: jax.Array) -> dict:
+    """Fused-group twin of ``seg_scan_add`` over shared ``reset`` streams."""
+    from .pallas_scan import affine_group
+
+    m = jnp.where(reset, 0, 1).astype(jnp.int32)
+    return affine_group(m, tuple(v.astype(jnp.int32) for v in values))
+
+
+def _poly_hash_group(
+    values: Tuple[jax.Array, ...],
+    in_seg: jax.Array,
+    seg_start: jax.Array,
+    mul: int = 31,
+) -> dict:
+    """Fused-group twin of ``_poly_hash_many`` (same m/acc construction)."""
+    from .pallas_scan import affine_group
+
+    m = jnp.where(seg_start, 0, jnp.where(in_seg, mul, 1)).astype(jnp.int32)
+    accs = tuple(jnp.where(in_seg, v, 0).astype(jnp.int32) for v in values)
+    return affine_group(m, accs)
+
+
+def _sum_group(values: Tuple[jax.Array, ...]) -> dict:
+    """Fused-group twin of ``jnp.sum(v, axis=1)`` per stream: an add scan
+    emitting only the final carry, so the totals never widen to [B, L]."""
+    from .pallas_scan import add_group
+
+    return add_group(tuple(v.astype(jnp.int32) for v in values), emit="last")
+
+
 def _scatter(values, idx, active, m, fill=0, op="set"):
     """Scatter per-char ``values`` at ``active`` positions into ``[B, m]``
     slots keyed by ``idx``.  With op="set", callers must guarantee one active
@@ -265,29 +311,71 @@ def structure(
     nonpunct = jnp.where(in_unit, (~punct).astype(jnp.int32), 0)
     alpha = jnp.where(in_unit, ((cls & ALPHA) != 0).astype(jnp.int32), 0)
 
-    if length <= 8192:
-        # Fuse the four per-unit aggregates into two packed add-scans: within
-        # a unit, chars <= 8192 (14 bits used: counts <= 2^13) and UTF-8
-        # bytes <= 4*8192 (field below bit 17), so len<<17|bytes and
-        # nonpunct<<16|alpha add without cross-field carries.
-        packed_a = seg_scan_add(ones * jnp.int32(1 << 17) + widths, unit_start)
-        packed_b = seg_scan_add(nonpunct * jnp.int32(1 << 16) + alpha, unit_start)
-        unit_len = packed_a >> 17
-        unit_bytes = packed_a & jnp.int32((1 << 17) - 1)
-        unit_valid = (packed_b >> 16) > 0
-        unit_alpha = (packed_b & jnp.int32((1 << 16) - 1)) > 0
-    else:
-        unit_len = seg_scan_add(ones, unit_start)
-        unit_bytes = seg_scan_add(widths, unit_start)
-        unit_valid = seg_scan_or(nonpunct, unit_start) > 0
-        unit_alpha = seg_scan_or(alpha, unit_start) > 0
+    from .pallas_scan import fused_scan, fused_scan_ok
 
     if with_hashes:
         lt = lower_table()
         low = lt[jnp.minimum(cps, lt.shape[0] - 1)]
-        unit_hash, unit_lhash = _poly_hash_many((cps, low), in_unit, unit_start)
+
+    if fused_scan_ok(*cps.shape):
+        # One kernel pass for every per-unit scan of this kernel: the packed
+        # aggregates and (when requested) both polynomial hash streams share
+        # the tile walk, so this replaces 2-3 scan dispatches with one and no
+        # intermediate stream round-trips HBM.  Same packed-field reasoning
+        # as the staged branch below; fused lengths are <= 16384, within the
+        # <= 8192-style field bounds only when length <= 8192, so the longer
+        # buckets take the unpacked 4-stream group (still one dispatch).
+        if length <= 8192:
+            groups = [
+                _seg_add_group(
+                    (
+                        ones * jnp.int32(1 << 17) + widths,
+                        nonpunct * jnp.int32(1 << 16) + alpha,
+                    ),
+                    unit_start,
+                )
+            ]
+        else:
+            groups = [_seg_add_group((ones, widths, nonpunct, alpha), unit_start)]
+        if with_hashes:
+            groups.append(_poly_hash_group((cps, low), in_unit, unit_start))
+        res = fused_scan(groups)
+        if length <= 8192:
+            packed_a, packed_b = res[0]
+            unit_len = packed_a >> 17
+            unit_bytes = packed_a & jnp.int32((1 << 17) - 1)
+            unit_valid = (packed_b >> 16) > 0
+            unit_alpha = (packed_b & jnp.int32((1 << 16) - 1)) > 0
+        else:
+            # Counts of {0,1} streams: "> 0" on a segmented SUM equals the
+            # staged branch's segmented OR bit-for-bit.
+            u_len, u_bytes, u_np, u_al = res[0]
+            unit_len, unit_bytes = u_len, u_bytes
+            unit_valid = u_np > 0
+            unit_alpha = u_al > 0
+        unit_hash, unit_lhash = res[1] if with_hashes else (None, None)
     else:
-        unit_hash = unit_lhash = None
+        if length <= 8192:
+            # Fuse the four per-unit aggregates into two packed add-scans:
+            # within a unit, chars <= 8192 (14 bits used: counts <= 2^13) and
+            # UTF-8 bytes <= 4*8192 (field below bit 17), so len<<17|bytes
+            # and nonpunct<<16|alpha add without cross-field carries.
+            packed_a = seg_scan_add(ones * jnp.int32(1 << 17) + widths, unit_start)
+            packed_b = seg_scan_add(nonpunct * jnp.int32(1 << 16) + alpha, unit_start)
+            unit_len = packed_a >> 17
+            unit_bytes = packed_a & jnp.int32((1 << 17) - 1)
+            unit_valid = (packed_b >> 16) > 0
+            unit_alpha = (packed_b & jnp.int32((1 << 16) - 1)) > 0
+        else:
+            unit_len = seg_scan_add(ones, unit_start)
+            unit_bytes = seg_scan_add(widths, unit_start)
+            unit_valid = seg_scan_or(nonpunct, unit_start) > 0
+            unit_alpha = seg_scan_or(alpha, unit_start) > 0
+
+        if with_hashes:
+            unit_hash, unit_lhash = _poly_hash_many((cps, low), in_unit, unit_start)
+        else:
+            unit_hash = unit_lhash = None
 
     valid_end = unit_end & unit_valid
     word_idx = jnp.cumsum(valid_end.astype(jnp.int32), axis=1) - 1
@@ -525,45 +613,87 @@ def gopher_quality_stats(
     st: TextStructure, stop_word_hashes: Sequence[int]
 ) -> Dict[str, jax.Array]:
     """Integer stats for GopherQualityFilter (gopher_quality.rs:69-295)."""
+    from .pallas_scan import fused_scan, fused_scan_ok
+
     cps, cls, mask = st.cps, st.cls, st.mask
     valid_end = st.unit_end & st.unit_valid
 
     n_words = st.n_words
-    sum_len = jnp.sum(jnp.where(valid_end, st.unit_len, 0), axis=1).astype(jnp.int32)
-
-    hash_count = jnp.sum((cps == ord("#")) & mask, axis=1).astype(jnp.int32)
 
     # Non-overlapping "..." count: dot-run lengths // 3 (str::matches parity).
     is_dot = (cps == ord(".")) & mask
     dot_start = is_dot & ~_shift_r(is_dot, False)
-    dot_run = seg_scan_add(is_dot.astype(jnp.int32), dot_start)
-    dot_end = is_dot & ~_shift_l(is_dot, False)
-    ellipsis_ascii = jnp.sum(jnp.where(dot_end, dot_run // 3, 0), axis=1)
-    ellipsis_uni = jnp.sum((cps == 0x2026) & mask, axis=1)
-    ellipsis_units = (ellipsis_ascii + ellipsis_uni).astype(jnp.int32)
 
     li = line_info(cps, mask)
     ws = (cls & WS) != 0
     nonws = li.content & ~ws
 
+    if stop_word_hashes:
+        sw = jnp.asarray(np.sort(np.array(stop_word_hashes, dtype=np.int32)))
+        is_stop = isin_sorted(st.unit_lhash, sw)
+    else:
+        is_stop = None
+
+    if fused_scan_ok(*cps.shape):
+        # One kernel for the phase's three independent scans (dot runs,
+        # first-/last-non-ws-in-line counters) plus every whole-row total
+        # that does not depend on a scan output — the totals emit as [B, 1]
+        # final carries, so no mask or count stream touches HBM.
+        totals = [
+            ((cps == ord("#")) & mask).astype(jnp.int32),
+            ((cps == 0x2026) & mask).astype(jnp.int32),
+            jnp.where(valid_end, st.unit_len, 0).astype(jnp.int32),
+            (valid_end & st.unit_alpha).astype(jnp.int32),
+        ]
+        if is_stop is not None:
+            totals.append((valid_end & is_stop).astype(jnp.int32))
+        r_reset = _first_col(mask) | _shift_r(rev(li.is_nl), False)
+        res = fused_scan(
+            [
+                _seg_add_group((is_dot.astype(jnp.int32),), dot_start),
+                _seg_add_group(
+                    (nonws.astype(jnp.int32),), _line_reset(li, mask)
+                ),
+                _seg_add_group((rev(nonws).astype(jnp.int32),), r_reset),
+                _sum_group(tuple(totals)),
+            ]
+        )
+        (dot_run,) = res[0]
+        leader = nonws & (res[1][0] == 1)
+        last_nonws = rev(rev(nonws) & (res[2][0] == 1))
+        t = res[3]
+        hash_count = t[0][:, 0]
+        ellipsis_uni = t[1][:, 0]
+        sum_len = t[2][:, 0]
+        alpha_words = t[3][:, 0]
+        stop_words = t[4][:, 0] if is_stop is not None else jnp.zeros_like(n_words)
+    else:
+        dot_run = seg_scan_add(is_dot.astype(jnp.int32), dot_start)
+        leader = _first_nonws_in_line(nonws, li, mask)
+        last_nonws = _last_nonws_in_line(nonws, li, mask)
+        hash_count = jnp.sum((cps == ord("#")) & mask, axis=1).astype(jnp.int32)
+        ellipsis_uni = jnp.sum((cps == 0x2026) & mask, axis=1).astype(jnp.int32)
+        sum_len = jnp.sum(
+            jnp.where(valid_end, st.unit_len, 0), axis=1
+        ).astype(jnp.int32)
+        alpha_words = jnp.sum(valid_end & st.unit_alpha, axis=1).astype(jnp.int32)
+        stop_words = (
+            jnp.sum(valid_end & is_stop, axis=1).astype(jnp.int32)
+            if is_stop is not None
+            else jnp.zeros_like(n_words)
+        )
+
+    dot_end = is_dot & ~_shift_l(is_dot, False)
+    ellipsis_ascii = jnp.sum(jnp.where(dot_end, dot_run // 3, 0), axis=1)
+    ellipsis_units = (ellipsis_ascii + ellipsis_uni).astype(jnp.int32)
+
     # Bullet lines: first non-ws char is '•' or '-' (trim_start semantics).
-    leader = _first_nonws_in_line(nonws, li, mask)
     is_bullet_char = (cps == 0x2022) | (cps == ord("-"))
     bullet_lines = jnp.sum(leader & is_bullet_char, axis=1).astype(jnp.int32)
 
     # Ellipsis-ended lines: last non-ws char is '…' or closes a >=3 dot run.
-    last_nonws = _last_nonws_in_line(nonws, li, mask)
     ell_line = last_nonws & ((cps == 0x2026) | (is_dot & (dot_run >= 3)))
     ellipsis_lines = jnp.sum(ell_line, axis=1).astype(jnp.int32)
-
-    alpha_words = jnp.sum(valid_end & st.unit_alpha, axis=1).astype(jnp.int32)
-
-    if stop_word_hashes:
-        sw = jnp.asarray(np.sort(np.array(stop_word_hashes, dtype=np.int32)))
-        is_stop = isin_sorted(st.unit_lhash, sw)
-        stop_words = jnp.sum(valid_end & is_stop, axis=1).astype(jnp.int32)
-    else:
-        stop_words = jnp.zeros_like(n_words)
 
     return {
         "n_words": n_words,
@@ -591,18 +721,55 @@ def fineweb_stats(
     mesh=None,
 ) -> Dict[str, jax.Array]:
     """Integer stats for FineWebQualityFilter (fineweb_quality.rs:71-225)."""
+    from .pallas_scan import fused_scan, fused_scan_ok
+
     cps, cls, mask = st.cps, st.cls, st.mask
     li = line_info(cps, mask)
     ws = (cls & WS) != 0
     nonws = li.content & ~ws
     reset = _line_reset(li, mask)
 
-    # Per-line cumulative values, scattered once at the line's last content
-    # char (single write per slot).
-    char_cnt = seg_scan_add(li.content.astype(jnp.int32), reset)
-    byte_cnt = seg_scan_add(jnp.where(li.content, utf8_width(cps), 0), reset)
-    has_nonws = seg_scan_or(nonws.astype(jnp.int32), reset)
-    line_hash = _poly_hash(cps, li.content, reset)
+    if fused_scan_ok(*cps.shape):
+        # One kernel for this filter's four line scans, the reversed
+        # last-non-ws counter, and the two whole-row totals.  has_nonws
+        # becomes a segmented SUM of the {0,1} stream — every consumer tests
+        # "> 0", where sum and or agree bit-for-bit.
+        r_reset = _first_col(mask) | _shift_r(rev(li.is_nl), False)
+        res = fused_scan(
+            [
+                _seg_add_group(
+                    (
+                        li.content.astype(jnp.int32),
+                        jnp.where(li.content, utf8_width(cps), 0),
+                        nonws.astype(jnp.int32),
+                    ),
+                    reset,
+                ),
+                _poly_hash_group((cps,), li.content, reset),
+                _seg_add_group((rev(nonws).astype(jnp.int32),), r_reset),
+                _sum_group(
+                    (
+                        (mask & ~li.is_nl).astype(jnp.int32),
+                        li.is_nl.astype(jnp.int32),
+                    )
+                ),
+            ]
+        )
+        char_cnt, byte_cnt, has_nonws = res[0]
+        (line_hash,) = res[1]
+        last_nonws = rev(rev(nonws) & (res[2][0] == 1))
+        total_chars_no_nl = res[3][0][:, 0]
+        newline_count = res[3][1][:, 0]
+    else:
+        # Per-line cumulative values, scattered once at the line's last
+        # content char (single write per slot).
+        char_cnt = seg_scan_add(li.content.astype(jnp.int32), reset)
+        byte_cnt = seg_scan_add(jnp.where(li.content, utf8_width(cps), 0), reset)
+        has_nonws = seg_scan_or(nonws.astype(jnp.int32), reset)
+        line_hash = _poly_hash(cps, li.content, reset)
+        last_nonws = _last_nonws_in_line(nonws, li, mask)
+        total_chars_no_nl = jnp.sum(mask & ~li.is_nl, axis=1).astype(jnp.int32)
+        newline_count = jnp.sum(li.is_nl, axis=1).astype(jnp.int32)
 
     lc = li.last_content
     if use_sort_tables():
@@ -625,15 +792,11 @@ def fineweb_stats(
 
     n_nonblank = jnp.sum(line_has_content, axis=1).astype(jnp.int32)
 
-    last_nonws = _last_nonws_in_line(nonws, li, mask)
     sc = jnp.asarray(np.sort(np.array([ord(c) for c in stop_chars], dtype=np.int32)))
     ends_stop_char = last_nonws & isin_sorted(cps, sc)
     ends_stop = jnp.sum(ends_stop_char, axis=1).astype(jnp.int32)
 
     dup_elems, dup_bytes = _dup_counts(line_hash_t, line_bytes, line_has_content, mesh)
-
-    total_chars_no_nl = jnp.sum(mask & ~li.is_nl, axis=1).astype(jnp.int32)
-    newline_count = jnp.sum(li.is_nl, axis=1).astype(jnp.int32)
 
     # Short-line count on device (the threshold is config-static), so the
     # [B, ML] line tables never leave the chip (fineweb_quality.rs:126-146).
@@ -1113,9 +1276,25 @@ def c4_stage(
         reset = _line_reset(li, mask)
 
         # Per-line trim: chars at/after the first non-ws, at/before the last.
-        after_first = seg_scan_add(nonws.astype(jnp.int32), reset) >= 1
+        from .pallas_scan import fused_scan, fused_scan_ok
+
         r_reset = _first_col(mask) | _shift_r(rev(li.is_nl), False)
-        before_last = rev(seg_scan_add(rev(nonws).astype(jnp.int32), r_reset) >= 1)
+        if fused_scan_ok(*cps.shape):
+            # The forward and reversed line counters are independent — one
+            # fused kernel pass instead of two staged scans.
+            res = fused_scan(
+                [
+                    _seg_add_group((nonws.astype(jnp.int32),), reset),
+                    _seg_add_group((rev(nonws).astype(jnp.int32),), r_reset),
+                ]
+            )
+            after_first = res[0][0] >= 1
+            before_last = rev(res[1][0] >= 1)
+        else:
+            after_first = seg_scan_add(nonws.astype(jnp.int32), reset) >= 1
+            before_last = rev(
+                seg_scan_add(rev(nonws).astype(jnp.int32), r_reset) >= 1
+            )
         in_line_trim = li.content & after_first & before_last
 
         deleted = _citation_deleted(li.content)

@@ -28,6 +28,15 @@ On real pods the same code runs unchanged: ``initialize()`` picks up the TPU
 coordinator, the mesh spans the slice, and ICI/DCN routing is XLA's choice —
 no NCCL/MPI analogue to manage (SURVEY.md §2.5's north-star mapping).
 
+Kernels (PR 8): mesh-sharded programs no longer fall back to the lax scans.
+``CompiledPipeline._build_fn`` traces them under ``mesh_tracing(mesh)``
+(:mod:`textblaster_tpu.ops.pallas_scan`), which makes every scan kernel —
+including the fused per-(bucket, phase) megakernel — dispatch through
+``shard_map`` over the ``data`` axis, the same pattern ``pallas_sort.sort2``
+has always used: each host's devices scan their own row shards in VMEM, and
+rows never cross devices so no collective is inserted.  The host-oracle
+degradation rung still runs pure Python and never sees Pallas code.
+
 Resilience (PR 4): each lockstep round resolves under the negotiated guard
 (:mod:`textblaster_tpu.resilience.negotiated`) — a retryable fault on any
 host triggers a jointly-negotiated retry/degradation so transient device

@@ -132,6 +132,7 @@ _TRACE_ENV_KNOBS = (
     "TEXTBLAST_PALLAS",
     "TEXTBLAST_NO_PALLAS",
     "TEXTBLAST_PALLAS_INTERPRET",
+    "TEXTBLAST_FUSED",
 )
 
 
