@@ -1040,6 +1040,184 @@ def main() -> int:
             f"{resilience_report['degraded_rounds']} degraded)"
         )
 
+    # --- Multi-host overlap A/B (BENCH_MULTIHOST_OVERLAP=0 skips).  Real
+    # 2-process coordinated CLI runs on the local box: overlapped lockstep
+    # window (--pipeline-depth 3) vs serial (--no-overlap --pipeline-depth 1),
+    # same input, same pipeline, shared AOT cache (one untimed warm run
+    # populates it so neither timed arm pays compile).  Throughput is the
+    # lockstep-section rate from each arm's merged --run-report (received
+    # docs over the max-over-hosts multihost_lockstep_seconds_total), which
+    # isolates the windowed round loop from reader/merge overheads.  Decision
+    # parity between the two arms must be 1.0 — the window is a scheduling
+    # change, not a semantic one.
+    mh_overlap_report = None
+    if os.environ.get("BENCH_MULTIHOST_OVERLAP", "1") != "0":
+        import socket
+        import tempfile
+
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        _MH_YAML = """
+pipeline:
+  - type: LanguageDetectionFilter
+    min_confidence: 0.5
+    allowed_languages: [ "dan", "eng" ]
+  - type: GopherRepetitionFilter
+    dup_line_frac: 0.3
+    top_n_grams: [[2, 0.25]]
+    dup_n_grams: [[5, 0.15]]
+  - type: GopherQualityFilter
+    min_doc_words: 4
+    min_stop_words: 1
+    stop_words: [ "og", "the", "er", "i" ]
+"""
+
+        def _mh_pass(root, inp, tag, extra_args):
+            out = os.path.join(root, f"{tag}-kept.parquet")
+            exc = os.path.join(root, f"{tag}-exc.parquet")
+            rep = os.path.join(root, f"{tag}-report.json")
+            with socket.socket() as s:
+                s.bind(("localhost", 0))
+                port = s.getsockname()[1]
+            env = {
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+                "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+                "HOME": os.environ.get("HOME", "/root"),
+                "TEXTBLAST_AOT_CACHE_DIR": os.path.join(root, "aot"),
+            }
+            procs = [
+                subprocess.Popen(
+                    [
+                        sys.executable, "-m", "textblaster_tpu.cli", "run",
+                        "--coordinator", f"localhost:{port}",
+                        "--num-processes", "2", "--process-id", str(pid),
+                        "-i", inp, "-o", out, "-e", exc,
+                        "-c", os.path.join(root, "cfg.yaml"),
+                        "--buckets", "512,2048",
+                        # 96 local docs / 16 rows = ~6 rounds per phase, so
+                        # the K-deep window actually opens (the CPU default
+                        # of 64 rows would leave ~1 round per phase).
+                        "--device-batch", "16",
+                        # The report contract: passed on every process (the
+                        # metrics allgather is collective); rank 0 writes it.
+                        "--run-report", rep,
+                        "--quiet", *extra_args,
+                    ],
+                    env=env, stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT, text=True,
+                )
+                for pid in (0, 1)
+            ]
+            logs = [p.communicate(timeout=700)[0] for p in procs]
+            for p, lg in zip(procs, logs):
+                if p.returncode != 0:
+                    raise RuntimeError(
+                        f"mh {tag} rank failed ({p.returncode}): {lg[-400:]}"
+                    )
+            with open(rep, encoding="utf-8") as f:
+                return json.load(f), out, exc
+
+        def _mh_rate(rep):
+            secs = max(
+                (
+                    h["metrics"].get("multihost_lockstep_seconds_total", 0.0)
+                    for h in rep.get("hosts", [])
+                ),
+                default=0.0,
+            )
+            n = rep["counts"].get("received", 0)
+            return (n / secs if secs > 0 else 0.0), secs
+
+        def _mh_rows(path):
+            return pq.read_table(path).to_pylist() if os.path.exists(path) else []
+
+        try:
+            with tempfile.TemporaryDirectory(prefix="bench-mh-") as root:
+                with open(os.path.join(root, "cfg.yaml"), "w",
+                          encoding="utf-8") as f:
+                    f.write(_MH_YAML)
+                mh_docs = [d for d in docs if len(d.content) <= 2040][:192]
+                inp = os.path.join(root, "input.parquet")
+                pq.write_table(
+                    pa.table(
+                        {
+                            "id": [d.id for d in mh_docs],
+                            "text": [d.content for d in mh_docs],
+                            "source": [d.source or "bench" for d in mh_docs],
+                        }
+                    ),
+                    inp,
+                )
+                _mh_pass(root, inp, "warm", ["--pipeline-depth", "1"])
+                se_rep, se_out, se_exc = _mh_pass(
+                    root, inp, "serial",
+                    ["--no-overlap", "--pipeline-depth", "1"],
+                )
+                ov_rep, ov_out, ov_exc = _mh_pass(
+                    root, inp, "overlap", ["--pipeline-depth", "3"]
+                )
+                ov_rate, ov_s = _mh_rate(ov_rep)
+                se_rate, se_s = _mh_rate(se_rep)
+                ov_rows = (_mh_rows(ov_out), _mh_rows(ov_exc))
+                se_rows = (_mh_rows(se_out), _mh_rows(se_exc))
+                ids = set()
+                agree = 0
+                for side in (0, 1):
+                    by_id = {
+                        r["id"]: (side, r.get("text"), r.get("metadata"))
+                        for r in se_rows[side]
+                    }
+                    for r in ov_rows[side]:
+                        ids.add(r["id"])
+                        if by_id.get(r["id"]) == (
+                            side, r.get("text"), r.get("metadata")
+                        ):
+                            agree += 1
+                    ids.update(by_id)
+                parity = agree / max(len(ids), 1)
+                res = ov_rep.get("resilience", {})
+                mh_overlap_report = {
+                    "overlapped_docs_per_sec": round(ov_rate, 2),
+                    "serial_docs_per_sec": round(se_rate, 2),
+                    "speedup": round(ov_rate / se_rate, 4) if se_rate else 0.0,
+                    "decision_parity": round(parity, 6),
+                    "ordered_identical": ov_rows == se_rows,
+                    "negotiated_depth": int(
+                        res.get("multihost_negotiated_depth", 0)
+                    ),
+                    "window_stall_s": round(
+                        sum(
+                            h["metrics"].get(
+                                "multihost_window_stall_seconds_total", 0.0
+                            )
+                            for h in ov_rep.get("hosts", [])
+                        ),
+                        3,
+                    ),
+                    "window_replayed_rounds": int(
+                        res.get("multihost_window_replayed_rounds_total", 0)
+                    ),
+                    "lockstep_s": {
+                        "overlapped": round(ov_s, 3),
+                        "serial": round(se_s, 3),
+                    },
+                    "n_docs": len(mh_docs),
+                    "processes": 2,
+                }
+                _log(
+                    f"multihost overlap: {ov_rate:.1f} docs/s depth="
+                    f"{mh_overlap_report['negotiated_depth']} vs "
+                    f"{se_rate:.1f} serial "
+                    f"(x{mh_overlap_report['speedup']}, parity {parity:.4f}, "
+                    f"ordered={mh_overlap_report['ordered_identical']}, "
+                    f"stall {mh_overlap_report['window_stall_s']}s)"
+                )
+        except Exception as e:  # never bill a 2-proc spawn problem to the bench
+            mh_overlap_report = {"error": f"{type(e).__name__}: {e}"[:500]}
+            _log(f"multihost overlap A/B skipped: {e}")
+
     # --- Tracing overhead, A/B (BENCH_TRACE=0 skips).  The span tracer is
     # a single attribute check when off; when on it adds two clock reads +
     # one locked list append per span.  This measures both sides on the
@@ -1182,6 +1360,11 @@ def main() -> int:
         # Fault-free A/B of the negotiated multi-host fault guard (docs/s
         # with the per-round verdict protocol on vs off) + its counters.
         **({"resilience": resilience_report} if resilience_report else {}),
+        # Overlapped-vs-serial multi-host lockstep A/B (2 coordinated
+        # processes on this box): lockstep-section docs/s both ways, the
+        # negotiated window depth, window stall seconds, and decision
+        # parity between the arms (must be 1.0 — scheduling, not semantics).
+        **({"multihost_overlap": mh_overlap_report} if mh_overlap_report else {}),
         # Trace on/off A/B over the device path: the span tracer must stay
         # within ~2% of the untraced rate when on and free when off.
         **({"trace": trace_report} if trace_report else {}),

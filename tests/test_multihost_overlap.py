@@ -1,0 +1,468 @@
+"""Lockstep overlap window tests (PR 9): negotiated depth, drained replay.
+
+Three layers:
+
+* **Unit** (tier-1): `_negotiate_depth` min-over-hosts rule + mismatch
+  trace, the `NegotiatedGuard.run_round(on_fault=...)` drain hook firing
+  exactly once per joint fault verdict, and the process-wide pack pool's
+  identity semantics.
+* **In-process** (tier-1): single-process `run_local_shard` at depth 3 vs
+  serial — byte-identical ordered outcome streams, fault-free AND under an
+  injected transient `multihost.round` fault (drained-window replay).
+* **2-process** (slow): real coordinated CLI runs — overlapped output
+  files byte-identical to `--no-overlap` serial, mismatched per-host
+  depths negotiate down to the min, a one-host fault at depth 3 converges
+  through the window drain with the replay landing in the merged run
+  report, and a SIGKILL mid-window still fails the gang fast.
+
+The spawn helper is a standalone copy of tests/test_multihost.py's (same
+env contract) — importing across test modules would couple the suites.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import subprocess
+import sys
+import time as _time
+from pathlib import Path
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from textblaster_tpu.config.pipeline import (
+    ResilienceConfig,
+    parse_pipeline_config,
+)
+from textblaster_tpu.data_model import TextDocument
+from textblaster_tpu.parallel import multihost as mh
+from textblaster_tpu.resilience import NegotiatedGuard
+from textblaster_tpu.resilience.faults import FAULTS
+from textblaster_tpu.utils.metrics import METRICS
+from textblaster_tpu.utils.trace import TRACER
+
+REPO = Path(__file__).parent.parent
+
+YAML = """
+pipeline:
+  - type: LanguageDetectionFilter
+    min_confidence: 0.5
+    allowed_languages: [ "dan", "eng" ]
+  - type: GopherRepetitionFilter
+    dup_line_frac: 0.3
+    top_n_grams: [[2, 0.25]]
+    dup_n_grams: [[5, 0.15]]
+  - type: GopherQualityFilter
+    min_doc_words: 4
+    min_stop_words: 1
+    stop_words: [ "og", "the", "er", "i" ]
+"""
+
+
+@pytest.fixture(autouse=True)
+def _hygiene():
+    # TRACER and FAULTS are process-global; leaked state would contaminate
+    # every later test in the session.
+    TRACER.close()
+    TRACER.drain()
+    FAULTS.reset()
+    yield
+    TRACER.close()
+    TRACER.drain()
+    FAULTS.reset()
+
+
+def _docs(n=24):
+    base = [
+        "Det er en god dag i dag, og vi skal ud at gå en lang tur i skoven nu.",
+        "The quick brown fox jumps over the lazy dog and the old stone bridge.",
+        "Samme linje her igen.\n" * 6,
+        "kort.",
+        "Endnu en dansk tekst om vejret, og den er ganske lang og fin at læse.",
+        "Vi mødes nede ved havnen i morgen, og så sejler vi ud på vandet.",
+    ]
+    rng = np.random.default_rng(7)
+    docs = []
+    for i in range(n):
+        t = base[i % len(base)]
+        if rng.random() < 0.25:
+            t = t + " Og lidt mere tekst til sidst her."
+        docs.append(TextDocument(id=f"ov-{i}", source="s", content=t))
+    return docs
+
+
+# --- depth negotiation units -------------------------------------------------
+
+
+def _fake_allgather(rows):
+    """host_allgather stand-in returning a fixed [n_proc, 1] depth column."""
+    arr = np.array(rows, dtype=np.int32).reshape(-1, 1)
+    return lambda vec: arr
+
+
+def test_negotiate_depth_min_over_hosts(monkeypatch):
+    monkeypatch.setattr(mh, "host_allgather", _fake_allgather([3, 2, 5]))
+    assert mh._negotiate_depth(3) == 2
+    # The joint depth is published as a gauge for the merged run report.
+    assert METRICS.get("multihost_negotiated_depth") == 2.0
+
+
+def test_negotiate_depth_floor_is_one(monkeypatch):
+    monkeypatch.setattr(mh, "host_allgather", _fake_allgather([1]))
+    assert mh._negotiate_depth(0) == 1
+    assert mh._negotiate_depth(-4) == 1
+
+
+def test_negotiate_depth_mismatch_traced(monkeypatch):
+    monkeypatch.setattr(mh, "host_allgather", _fake_allgather([3, 2, 5]))
+    TRACER.configure(None)
+    mh._negotiate_depth(3)
+    TRACER.close()
+    inst = [e for e in TRACER.drain() if e["name"] == "window_depth_mismatch"]
+    assert len(inst) == 1
+    assert inst[0]["args"]["host_depths"] == [3, 2, 5]
+    assert inst[0]["args"]["joint"] == 2
+
+
+def test_negotiate_depth_uniform_not_traced(monkeypatch):
+    monkeypatch.setattr(mh, "host_allgather", _fake_allgather([2, 2]))
+    TRACER.configure(None)
+    assert mh._negotiate_depth(2) == 2
+    TRACER.close()
+    assert not [
+        e for e in TRACER.drain() if e["name"] == "window_depth_mismatch"
+    ]
+
+
+# --- on_fault drain hook units ----------------------------------------------
+
+
+def _mk_guard(max_retries=2):
+    rc = ResilienceConfig(
+        max_retries=max_retries,
+        backoff_base_s=0.01,
+        backoff_max_s=1.0,
+        backoff_multiplier=2.0,
+        breaker_threshold=3,
+    )
+    return NegotiatedGuard(rc, buckets=(512,), sleep=lambda s: None)
+
+
+def test_on_fault_not_called_on_clean_round():
+    guard = _mk_guard()
+    drains = []
+    stats = guard.run_round(
+        512, lambda: "out", lambda out: {"ok": np.ones(1)},
+        on_fault=drains.append,
+    )
+    assert stats is not None and drains == []
+
+
+def test_on_fault_fires_once_before_first_retry():
+    guard = _mk_guard()
+    events = []
+
+    def dispatch():
+        events.append("dispatch")
+        if len([e for e in events if e == "dispatch"]) <= 2:
+            raise OSError("transient")
+        return "out"
+
+    stats = guard.run_round(
+        512, dispatch, lambda out: {"ok": np.ones(1)},
+        on_fault=lambda: events.append("drain"),
+    )
+    assert stats is not None
+    # Drain convenes on the FIRST joint fault verdict only — before the
+    # retry re-dispatch, never again on later verdicts of the same round.
+    assert events == ["dispatch", "drain", "dispatch", "dispatch"]
+
+
+def test_on_fault_fires_on_launch_fault_without_dispatch():
+    guard = _mk_guard()
+    drains = []
+    # The overlapped launch already raised: attempt 0 goes straight to the
+    # verdict, which must still fire the drain hook before the retry.
+    stats = guard.run_round(
+        512, lambda: "out", lambda out: {"ok": np.ones(1)},
+        launch_fault=True, on_fault=lambda: drains.append(1),
+    )
+    assert stats is not None and drains == [1]
+
+
+def test_on_fault_fires_even_when_round_degrades():
+    guard = _mk_guard(max_retries=0)
+    drains = []
+
+    def dispatch():
+        raise OSError("persistent")
+
+    stats = guard.run_round(
+        512, dispatch, lambda out: {"ok": np.ones(1)},
+        on_fault=lambda: drains.append(1),
+    )
+    assert stats is None and drains == [1]
+
+
+# --- shared pack pool units --------------------------------------------------
+
+
+def test_shared_pack_pool_is_process_wide():
+    from textblaster_tpu.utils.overlap import shared_pack_pool
+
+    a, b = shared_pack_pool(2), shared_pack_pool(2)
+    assert a is b  # one pool per worker count, reused across callers
+    assert shared_pack_pool(3) is not a  # executors cannot resize
+    assert shared_pack_pool(0) is shared_pack_pool(1)  # floored, not 0
+    assert a.submit(lambda: 41 + 1).result() == 42
+
+
+# --- in-process window parity (single process, real device path) -------------
+
+
+def _run_shard(config, docs, pipeline):
+    outs = mh.run_local_shard(
+        config, [d.copy() for d in docs], buckets=(512,), pipeline=pipeline
+    )
+    return [
+        (o.kind, o.document.id, o.document.content, o.document.metadata)
+        for o in outs
+    ]
+
+
+def test_window_byte_parity_and_fault_replay_inprocess():
+    """Depth 3 vs serial on the real single-process lockstep path: ordered
+    outcome streams must be identical fault-free AND under an injected
+    transient `multihost.round` fault (which must drain + replay the
+    launched-ahead window, visible in trace and metrics)."""
+    from textblaster_tpu.ops.pipeline import CompiledPipeline
+
+    config = parse_pipeline_config(YAML)
+    docs = _docs(24)
+    # batch_size=8 -> 3 rounds per phase: enough to fill a depth-3 window.
+    pipeline = CompiledPipeline(config, buckets=(512,), batch_size=8)
+
+    config.overlap.enabled = False
+    serial = _run_shard(config, docs, pipeline)
+    assert len(serial) == len(docs)
+
+    config.overlap.enabled = True
+    config.overlap.pipeline_depth = 3
+    overlapped = _run_shard(config, docs, pipeline)
+    assert overlapped == serial  # ordered, content + metadata
+
+    # Transient fault on the FIRST launch: rounds 1-2 are launched ahead
+    # (depth 3) when round 0's verdict convenes, so the drain must discard
+    # and replay them bit-exactly.
+    replayed_before = METRICS.get("multihost_window_replayed_rounds_total")
+    TRACER.configure(None)
+    FAULTS.inject("multihost.round", OSError("injected blip"))
+    try:
+        faulted = _run_shard(config, docs, pipeline)
+    finally:
+        FAULTS.reset()
+        TRACER.close()
+    assert faulted == serial
+    drained = [e for e in TRACER.drain() if e["name"] == "window_drained"]
+    assert drained, "fault verdict must drain the window"
+    assert any(e["args"]["replayed"] >= 1 for e in drained)
+    assert METRICS.get("multihost_window_replayed_rounds_total") > replayed_before
+
+
+# --- 2-process coordinated runs (slow) ---------------------------------------
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_cli(tmp_path, docs, yaml_text, timeout=560, per_proc_args=None,
+               extra_env=None, per_proc_env=None, tag="run", wait=True):
+    """Run the 2-process coordinated CLI; ``per_proc_args[pid]`` appends
+    rank-specific CLI args (how the two ranks get different depths)."""
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(yaml_text, encoding="utf-8")
+    inp = tmp_path / "input.parquet"
+    if not inp.exists():
+        pq.write_table(
+            pa.table(
+                {
+                    "id": [d.id for d in docs],
+                    "text": [d.content for d in docs],
+                    "source": [d.source for d in docs],
+                }
+            ),
+            inp,
+        )
+    out = tmp_path / f"{tag}-kept.parquet"
+    exc = tmp_path / f"{tag}-excluded.parquet"
+    rep = tmp_path / f"{tag}-report.json"
+    port = _free_port()
+    procs = []
+    try:
+        for pid in (0, 1):
+            env = {
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+                "PATH": "/usr/bin:/bin:/usr/local/bin",
+                "HOME": "/root",
+            }
+            env.update(extra_env or {})
+            env.update((per_proc_env or {}).get(pid, {}))
+            procs.append(
+                subprocess.Popen(
+                    [
+                        sys.executable, "-m", "textblaster_tpu.cli", "run",
+                        "--coordinator", f"localhost:{port}",
+                        "--num-processes", "2",
+                        "--process-id", str(pid),
+                        "-i", str(inp),
+                        "-o", str(out),
+                        "-e", str(exc),
+                        "-c", str(cfg),
+                        "--buckets", "512,2048",
+                        # 24 local docs / 8 rows = 3 rounds per phase in the
+                        # short bucket — enough plan depth to fill a K=3
+                        # window (the CPU default of 64 rows would collapse
+                        # every phase to one round and never open it).
+                        "--device-batch", "8",
+                        # The report allgather is collective: every rank
+                        # passes the flag, rank 0 writes the merged file.
+                        "--run-report", str(rep),
+                        "--quiet",
+                        *(per_proc_args or {}).get(pid, ()),
+                    ],
+                    cwd=str(REPO),
+                    env=env,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT,
+                    text=True,
+                )
+            )
+        outputs = []
+        if wait:
+            for p in procs:
+                o, _ = p.communicate(timeout=timeout)
+                outputs.append(o)
+    finally:
+        if wait:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+    return procs, outputs, out, exc, rep
+
+
+def _rows(path):
+    return pq.read_table(path).to_pylist() if path.exists() else []
+
+
+@pytest.mark.slow
+def test_two_process_overlap_byte_identical_to_serial(tmp_path: Path):
+    """Overlapped (depth 3 vs 2 across the ranks -> joint 2) output files
+    must be byte-identical (same rows, same order) to a --no-overlap serial
+    run of the same input, and the merged report must carry the negotiated
+    depth."""
+    docs = _docs(48)
+    procs, outputs, s_out, s_exc, _ = _spawn_cli(
+        tmp_path, docs, YAML, tag="serial",
+        per_proc_args={
+            0: ("--no-overlap", "--pipeline-depth", "1"),
+            1: ("--no-overlap", "--pipeline-depth", "1"),
+        },
+    )
+    for p, o in zip(procs, outputs):
+        assert p.returncode == 0, o[-2000:]
+    procs, outputs, o_out, o_exc, rep = _spawn_cli(
+        tmp_path, docs, YAML, tag="overlap",
+        per_proc_args={
+            0: ("--pipeline-depth", "3"),
+            1: ("--pipeline-depth", "2"),
+        },
+    )
+    for p, o in zip(procs, outputs):
+        assert p.returncode == 0, o[-2000:]
+    assert _rows(o_out) == _rows(s_out)  # ordered row-for-row identity
+    assert _rows(o_exc) == _rows(s_exc)
+    report = json.loads(rep.read_text(encoding="utf-8"))
+    # Min-over-hosts: ranks asked for 3 and 2, the gang runs at 2.
+    assert report["resilience"]["multihost_negotiated_depth"] == 2
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_overlap_fault_replay_converges_with_parity(tmp_path: Path):
+    """A transient one-host fault at depth 3: the joint verdict drains the
+    launched-ahead window on every host, the replayed rounds land in the
+    merged report, and the output is byte-identical to fault-free serial."""
+    docs = _docs(48)
+    procs, outputs, s_out, s_exc, _ = _spawn_cli(
+        tmp_path, docs, YAML, tag="serial",
+        per_proc_args={0: ("--no-overlap",), 1: ("--no-overlap",)},
+    )
+    for p, o in zip(procs, outputs):
+        assert p.returncode == 0, o[-2000:]
+    procs, outputs, f_out, f_exc, rep = _spawn_cli(
+        tmp_path, docs, YAML, tag="faulted",
+        per_proc_args={
+            0: ("--pipeline-depth", "3"),
+            1: ("--pipeline-depth", "3"),
+        },
+        extra_env={
+            "TEXTBLAST_FAULTS": "multihost.round:after=1:times=2",
+            "TEXTBLAST_FAULTS_PROCESS": "1",
+        },
+    )
+    for p, o in zip(procs, outputs):
+        assert p.returncode == 0, o[-2000:]
+    assert _rows(f_out) == _rows(s_out)
+    assert _rows(f_exc) == _rows(s_exc)
+    res = json.loads(rep.read_text(encoding="utf-8"))["resilience"]
+    assert res["multihost_negotiated_depth"] == 3
+    assert res["resilience_negotiated_retries_total"] > 0
+    # Both hosts drain: the faulting host discards real launched-ahead
+    # results, so the joint replay counter is nonzero in the merged report.
+    assert res["multihost_window_replayed_rounds_total"] >= 1
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_sigkill_at_depth_fails_fast_not_hang(tmp_path: Path):
+    """SIGKILL one rank while a depth-3 window is in flight: the survivor
+    must fail fast on the next collective (heartbeat/UNAVAILABLE), never
+    hang waiting on a window slot a dead peer will not fill."""
+    docs = [
+        TextDocument(
+            id=f"k-{i}", source="s",
+            content=(
+                "Det er en god dag i dag, og vi skal ud at gå en lang tur "
+                "i skoven, og den er ganske fin at læse om vejret nu."
+            ),
+        )
+        for i in range(4096)
+    ]
+    procs, _, _, _, _ = _spawn_cli(
+        tmp_path, docs, YAML, tag="kill", wait=False,
+        per_proc_args={
+            0: ("--pipeline-depth", "3"),
+            1: ("--pipeline-depth", "3"),
+        },
+    )
+    try:
+        _time.sleep(12)  # both joined the coordination barrier by now
+        if procs[0].poll() is not None or procs[1].poll() is not None:
+            pytest.skip("run completed before the kill could land")
+        procs[1].kill()
+        out0, _ = procs[0].communicate(timeout=360)
+        assert procs[0].returncode != 0, "survivor must fail, not succeed"
+        assert "heartbeat" in out0.lower() or "unavailable" in out0.lower(), (
+            out0[-1500:]
+        )
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()

@@ -317,6 +317,7 @@ def run_stripe_checkpointed(
     record_dead: bool = False,
     retry_policy: Optional[RetryPolicy] = None,
     on_chunk: Optional[Callable[[CheckpointState], None]] = None,
+    read_ahead: int = 0,
 ) -> bool:
     """Advance one input stripe's cursor chunk by chunk (``--elastic``).
 
@@ -340,6 +341,13 @@ def run_stripe_checkpointed(
       the lease-TTL window writes to *different* files — the cursor, with
       its single atomic writer-wins rename, is the only commit point, and
       an unrecorded part from the loser is a stray file, not corruption.
+
+    ``read_ahead`` > 0 overlaps reading with processing: a prefetch thread
+    decodes up to that many chunk-sized blocks ahead, keeping
+    ``read_ahead + 1`` stripe chunks in flight per process while commit
+    semantics are untouched — the reader only runs AHEAD of consumption,
+    ``rows_consumed`` still counts exactly the items drained into chunks,
+    and commits stay at chunk boundaries in stripe order.
 
     Returns ``True`` when the stripe is fully consumed, ``False`` on
     :class:`StripeLost`.  Counts fold into ``state`` only at commit, so a
@@ -370,6 +378,12 @@ def run_stripe_checkpointed(
         ),
         take_rows - state.rows_consumed,
     )
+    raw_close = None
+    if read_ahead > 0:
+        from .utils.overlap import prefetch_iter
+
+        raw = prefetch_iter(raw, depth=read_ahead, block=chunk_size)
+        raw_close = raw.close
     try:
         while True:
             if fence is not None:
@@ -426,6 +440,9 @@ def run_stripe_checkpointed(
         out_parts.abort()
         excl_parts.abort()
         raise
+    finally:
+        if raw_close is not None:
+            raw_close()
 
 
 def run_checkpointed(

@@ -1518,7 +1518,9 @@ class CompiledPipeline:
         arrays are assembled per-process (``make_array_from_process_local_data``
         against the caller's global shardings) and occupancy is NOT recorded —
         the caller records it once per round so negotiated re-dispatches don't
-        skew the telemetry."""
+        skew the telemetry.  ``batch`` is any pre-packed ``PackedBatch`` —
+        the lockstep window packs rounds ahead on the shared pack pool and
+        hands the resolved batches here, so this seam must stay pack-free."""
         FAULTS.fire("multihost.round")
         with TRACER.span(
             "device_dispatch",
@@ -1778,12 +1780,14 @@ class CompiledPipeline:
             METRICS.inc("stage_pack_seconds", _time_mod.perf_counter() - t0)
 
     def _pack_pool(self):
+        # One process-wide pool shared with the multi-host lockstep window
+        # (utils/overlap.py) — pack work releases the GIL, and every caller
+        # resolves its own futures FIFO, so sharing changes no ordering.
         if self._pack_pool_obj is None:
-            from concurrent.futures import ThreadPoolExecutor
+            from ..utils.overlap import shared_pack_pool
 
-            self._pack_pool_obj = ThreadPoolExecutor(
-                max_workers=max(1, self._overlap.pack_workers),
-                thread_name_prefix="textblast-pack",
+            self._pack_pool_obj = shared_pack_pool(
+                max(1, self._overlap.pack_workers)
             )
         return self._pack_pool_obj
 

@@ -57,6 +57,16 @@ survivors adopt a dead rank's input stripe at the membership-epoch bump,
 and a SIGKILLed rank can be relaunched to rejoin in place from its
 committed cursor — replaying zero completed chunks, outcomes
 byte-identical to a fault-free run.
+
+Overlap (PR 9): lockstep rounds ride a K-deep in-flight window where K is
+the **min** over every host's ``OverlapConfig.pipeline_depth``, allgathered
+once at shard start (:func:`_negotiate_depth`) — depth is lockstep state,
+so it cannot be a per-host choice.  Packing runs ahead on the shared
+pack-worker pool (including the next phase's survivor chunks, packed while
+the current phase's tail rounds still resolve), launches run up to K ahead
+of unresolved verdicts, resolves stay strict FIFO, and a negotiated fault
+verdict drains the window so every host re-dispatches the younger rounds
+in the identical order — serial and overlapped runs stay byte-identical.
 """
 
 from __future__ import annotations
@@ -72,7 +82,6 @@ import numpy as np
 from ..config.pipeline import PipelineConfig
 from ..data_model import ProcessingOutcome, TextDocument
 from ..errors import PeerFailure
-from ..ops.packing import pack_documents
 from ..resilience.membership import (
     DEFAULT_EXCHANGE_DEADLINE_S,
     DEFAULT_LEASE_TTL_S,
@@ -565,6 +574,34 @@ def _negotiate_max(needed_local: np.ndarray) -> np.ndarray:
     return host_allgather(needed_local).max(axis=0).astype(np.int32)
 
 
+def _negotiate_depth(local_depth: int) -> int:
+    """Joint in-flight window depth: the MIN over every host's configured
+    ``OverlapConfig.pipeline_depth`` (one extra startup allgather, zero
+    per-round exchanges).
+
+    Depth is lockstep state: every host must launch and resolve the
+    identical round sequence with the identical interleave, so a host
+    configured shallower than its peers pulls the whole gang down to what
+    it can sustain — min, not max, because depth K means K launches may
+    run ahead of unresolved verdicts and the most conservative host bounds
+    what all hosts may assume about each other's dispatch order.  A
+    mismatch is legal (hosts merely negotiate down) but surfaced in the
+    trace so an operator can see which rank capped the window."""
+    from ..utils.metrics import METRICS
+
+    depths = host_allgather(
+        np.array([max(1, int(local_depth))], dtype=np.int32)
+    )[:, 0]
+    joint = max(1, int(depths.min()))
+    METRICS.set("multihost_negotiated_depth", float(joint))
+    if int(depths.max()) != joint:
+        TRACER.instant(
+            "window_depth_mismatch",
+            {"host_depths": [int(d) for d in depths], "joint": joint},
+        )
+    return joint
+
+
 def _align_trace_clocks() -> None:
     """Cross-host trace clock handshake (one allgather at run start).
 
@@ -632,12 +669,29 @@ def run_local_shard(
     oracle for the rest of the run.  The guard's only lockstep addition is
     one 1-int allgather per round resolution — the fault-free program
     sequence is unchanged.
+
+    Overlap (PR 9): rounds ride a K-deep in-flight window, where K is the
+    min over every host's ``OverlapConfig.pipeline_depth``, allgathered
+    once at shard start (:func:`_negotiate_depth` — depth is lockstep
+    state, so it cannot be a per-host choice).  Packing runs ahead on the
+    shared pack pool (rounds r+1..r+K pack while round r executes, and the
+    next phase's full survivor chunks pack while this phase's tail rounds
+    still resolve), launches run up to K ahead of unresolved verdicts, and
+    resolves stay strict FIFO — so serial (depth 1 / ``--no-overlap``) and
+    overlapped runs produce byte-identical outcome streams.  A negotiated
+    fault verdict drains the window: every host discards its launched-ahead
+    results and the younger rounds re-dispatch fresh at their own resolve,
+    keeping the post-verdict global program order identical on every host.
     """
+    import os
+    from collections import deque
+
     from ..ops.pipeline import CompiledPipeline, maybe_warmup, record_occupancy
     from ..orchestration import execute_processing_pipeline
     from ..resilience.negotiated import NegotiatedGuard
     from ..resilience.retry import classify_error
     from ..utils.metrics import METRICS
+    from ..utils.overlap import shared_pack_pool
 
     from ..ops.packing import PACK_MARGIN
 
@@ -701,6 +755,24 @@ def run_local_shard(
     guard = NegotiatedGuard(config.resilience, buckets=buckets) if fault_guard else None
     degraded: List[TextDocument] = []
 
+    # Joint window depth: a collective, so EVERY host negotiates it even
+    # when its own overlap is off (its local depth is then 1, pulling the
+    # whole gang to serial — min rule).
+    overlap_cfg = getattr(config, "overlap", None)
+    overlapped = (
+        overlap_cfg is not None
+        and overlap_cfg.enabled
+        and os.environ.get("TEXTBLAST_NO_OVERLAP") != "1"
+    )
+    depth = _negotiate_depth(
+        max(1, overlap_cfg.pipeline_depth) if overlapped else 1
+    )
+    # Pack off the critical path: the process-wide pool (shared with the
+    # single-host packers) packs rounds ahead of the launch cursor and the
+    # next phase's survivor chunks behind the resolve cursor.  Serial mode
+    # (--no-overlap) packs inline on this thread, exactly as before.
+    pool = shared_pack_pool(max(1, overlap_cfg.pack_workers)) if overlapped else None
+
     def launch(local, ph):
         """Guarded async launch.  Returns ``(out, launch_fault)``: a
         retryable launch failure is captured, not raised — the verdict has
@@ -714,37 +786,23 @@ def run_local_shard(
                 raise
             return None, True
 
-    def resolve(entry, outcomes, survivors):
-        """Block for one in-flight round and assemble it — under the
-        negotiated verdict protocol when the guard is on."""
-        local, ph = entry["batch"], entry["phase"]
-        with TRACER.span(
-            "lockstep_resolve", {"bucket": entry["bucket"], "phase": ph}
-        ):
-            if guard is None:
-                stats = _local_stats(entry["out"])
-            else:
-                b = entry["bucket"]
-                stats = guard.run_round(
-                    b,
-                    dispatch=lambda: pipeline.dispatch_lockstep(
-                        local, ph, sh2, sh1
-                    ),
-                    fetch=_local_stats,
-                    inflight=entry["out"],
-                    launch_fault=entry["fault"],
-                )
-                if stats is None:
-                    # Jointly degraded: every host routes this round's chunk
-                    # to the host oracle; none re-enters the program.
-                    degraded.extend(local.docs)
-                    return
-            po, alive = pipeline.assemble_phase(local, stats, ph)
-            outcomes.extend(po)
-            survivors.extend(alive)
+    def phase_rewrites(ph: int) -> bool:
+        # Only C4QualityFilter rewrites survivor content mid-phase (line
+        # drops); every other device step decides and stamps.  Phases
+        # without it preserve lengths, so each survivor's bucket is its
+        # round's bucket and the re-partition length scan is skipped.
+        return any(
+            pipeline.device_steps[i].type == "C4QualityFilter"
+            for i in pipeline.phases[ph]
+        )
 
     outcomes: List[ProcessingOutcome] = []
     n_phases = len(pipeline.phases)
+    lockstep_t0 = time.perf_counter()
+    # Cross-phase pre-pack handoff: pack futures for the next phase's full
+    # survivor chunks, keyed (bucket, round), built while this phase's tail
+    # rounds are still resolving.
+    prepack_next: dict = {}
     for phase in range(n_phases):
         # Exchange epochs advance with the negotiated phase sequence — a
         # piece of round state every process derives identically without
@@ -763,49 +821,190 @@ def run_local_shard(
                 f"(local {int(needed_local.sum())}), got {rounds}"
             )
 
-        survivors: List[TextDocument] = []
-        pending = None  # one guarded round in flight (dict entry)
+        # The phase's launch plan, in the negotiated (bucket, round) order
+        # every host shares.  The negotiated count covers the local ceil by
+        # construction; a violation would silently strand a tail chunk once
+        # launches run ahead of resolves, so fail loudly instead.
+        plan: List[tuple] = []
         for b, n_rounds in zip(buckets, schedule):
             local_batch = local_for[b]
+            assert int(n_rounds) * local_batch >= len(current[b]), (
+                f"bucket {b}: negotiated {int(n_rounds)} round(s) of "
+                f"{local_batch} rows cannot cover {len(current[b])} local "
+                "documents — geometry round-up stranded a tail chunk"
+            )
             for r in range(int(n_rounds)):
-                chunk = current[b][r * local_batch : (r + 1) * local_batch]
-                if guard is not None and guard.bucket_degraded(b):
-                    # Breaker latched on negotiated verdicts, so every host
-                    # reaches the same conclusion at the same round and the
-                    # dispatch is skipped jointly — lockstep preserved
-                    # without touching the device.
-                    METRICS.inc("resilience_negotiated_degraded_rounds_total")
-                    TRACER.instant(
-                        "negotiated_bucket_latched",
-                        {"bucket": b, "round": r, "phase": phase},
-                    )
-                    degraded.extend(chunk)
+                plan.append(
+                    (b, r, current[b][r * local_batch : (r + 1) * local_batch])
+                )
+
+        inherited = prepack_next  # this phase's pre-packed chunks
+        prepack_next = {}
+        packs: dict = {}  # plan index -> PackedBatch (or its future)
+
+        def ensure_packed(j):
+            """Keep rounds j..j+K packed (or packing) ahead of the launch
+            cursor; cross-phase pre-packed chunks are adopted as-is."""
+            for k in range(j, min(j + depth + 1, len(plan))):
+                if k in packs:
                     continue
-                with TRACER.span(
-                    "lockstep_round",
-                    {"bucket": b, "round": r, "phase": phase,
-                     "rows": len(chunk)},
-                ):
-                    local = pack_documents(
-                        chunk, batch_size=local_batch, max_len=b
+                kb, kr, kchunk = plan[k]
+                pre = inherited.pop((kb, kr), None)
+                if pre is not None:
+                    packs[k] = pre
+                elif pool is not None:
+                    packs[k] = pool.submit(
+                        pipeline._timed_pack, kchunk,
+                        batch_size=local_for[kb], max_len=kb,
                     )
-                    record_occupancy(local)
-                    out, fault = launch(local, phase)
-                if pending is not None:
-                    resolve(pending, outcomes, survivors)
-                pending = {
-                    "batch": local, "bucket": b, "phase": phase,
-                    "out": out, "fault": fault,
-                }
-        if pending is not None:
-            resolve(pending, outcomes, survivors)
-        if phase == n_phases - 1:
+                else:
+                    packs[k] = pipeline._timed_pack(
+                        kchunk, batch_size=local_for[kb], max_len=kb
+                    )
+
+        last = phase == n_phases - 1
+        rewrites = (not last) and phase_rewrites(phase)
+        next_current: dict = {b: [] for b in buckets}
+        next_over: List[TextDocument] = []
+        prepack_done = {b: 0 for b in buckets}
+
+        def absorb(src_bucket, alive):
+            """Fold one resolved round's survivors into the next phase —
+            incrementally, in resolve order (== the old flat-list partition
+            order), so full next-phase chunks can pack while this phase
+            still has rounds in flight (the next ``_negotiate_max`` needs
+            only the final counts, exchanged after the drain as before)."""
+            if last:
+                return
+            if rewrites:
+                # Survivor content may have been rewritten (C4) — re-route
+                # by current length.  Growth past every bucket is
+                # impossible (rewrites only drop chars), but route
+                # defensively anyway.
+                for d in alive:
+                    for nb in buckets:
+                        if len(d.content) <= nb - PACK_MARGIN:
+                            next_current[nb].append(d)
+                            break
+                    else:
+                        next_over.append(d)
+            else:
+                next_current[src_bucket].extend(alive)
+            if pool is None:
+                return
+            for nb in buckets if rewrites else (src_bucket,):
+                lb = local_for[nb]
+                k = prepack_done[nb]
+                # A full chunk's document prefix is final once appended
+                # (later resolves only extend the list), so it can pack now.
+                while (k + 1) * lb <= len(next_current[nb]):
+                    prepack_next[(nb, k)] = pool.submit(
+                        pipeline._timed_pack,
+                        next_current[nb][k * lb : (k + 1) * lb],
+                        batch_size=lb, max_len=nb,
+                    )
+                    k += 1
+                prepack_done[nb] = k
+
+        window: deque = deque()
+
+        def drain_window():
+            """Joint fault verdict convened at the window front: discard
+            this host's launched-ahead results so every host's program
+            order after the verdict is the same ``[retry(r), r+1, ...]`` —
+            the younger rounds re-dispatch fresh at their own resolve."""
+            n = sum(1 for e in window if e["out"] is not None or e["fault"])
+            for e in window:
+                e["out"] = None
+                e["fault"] = False
+            if n:
+                METRICS.inc("multihost_window_replayed_rounds_total", n)
+            TRACER.instant(
+                "window_drained",
+                {"replayed": n, "pending": len(window), "phase": phase},
+            )
+
+        def resolve_front():
+            """Block for the OLDEST in-flight round and assemble it — under
+            the negotiated verdict protocol when the guard is on.  Strict
+            FIFO at every depth: the window moves waits, never sequence."""
+            entry = window.popleft()
+            TRACER.counter("lockstep_window", len(window))
+            local, ph, eb = entry["batch"], entry["phase"], entry["bucket"]
+            t0 = time.perf_counter()
+            try:
+                with TRACER.span(
+                    "lockstep_resolve", {"bucket": eb, "phase": ph}
+                ):
+                    if guard is None:
+                        stats = _local_stats(entry["out"])
+                    else:
+                        stats = guard.run_round(
+                            eb,
+                            dispatch=lambda: pipeline.dispatch_lockstep(
+                                local, ph, sh2, sh1
+                            ),
+                            fetch=_local_stats,
+                            inflight=entry["out"],
+                            launch_fault=entry["fault"],
+                            on_fault=drain_window,
+                        )
+                        if stats is None:
+                            # Jointly degraded: every host routes this
+                            # round's chunk to the host oracle; none
+                            # re-enters the program.
+                            degraded.extend(local.docs)
+                            return
+                    po, alive = pipeline.assemble_phase(local, stats, ph)
+                    outcomes.extend(po)
+                    absorb(eb, alive)
+            finally:
+                METRICS.inc(
+                    "multihost_window_stall_seconds_total",
+                    time.perf_counter() - t0,
+                )
+
+        for j, (b, r, chunk) in enumerate(plan):
+            if guard is not None and guard.bucket_degraded(b):
+                # Breaker latched on negotiated verdicts, so every host
+                # reaches the same conclusion at the same round and the
+                # dispatch is skipped jointly — lockstep preserved
+                # without touching the device.
+                METRICS.inc("resilience_negotiated_degraded_rounds_total")
+                TRACER.instant(
+                    "negotiated_bucket_latched",
+                    {"bucket": b, "round": r, "phase": phase},
+                )
+                packs.pop(j, None)
+                degraded.extend(chunk)
+                continue
+            ensure_packed(j)
+            with TRACER.span(
+                "lockstep_round",
+                {"bucket": b, "round": r, "phase": phase,
+                 "rows": len(chunk)},
+            ):
+                item = packs.pop(j)
+                local = item.result() if hasattr(item, "result") else item
+                record_occupancy(local)
+                out, fault = launch(local, phase)
+            window.append({
+                "batch": local, "bucket": b, "phase": phase,
+                "out": out, "fault": fault,
+            })
+            TRACER.counter("lockstep_window", len(window))
+            while len(window) > depth:
+                resolve_front()
+        while window:
+            resolve_front()
+        if last:
             break
-        # Survivor content may have been rewritten (C4) — repack by the
-        # current length.  Growth past every bucket is impossible (rewrites
-        # only drop chars), but route defensively anyway.
-        current, over = partition(survivors)
-        fallback.extend(over)
+        fallback.extend(next_over)
+        current = next_current
+    METRICS.inc(
+        "multihost_lockstep_seconds_total",
+        time.perf_counter() - lockstep_t0,
+    )
 
     for d in fallback:
         METRICS.inc("worker_host_fallback_total")
@@ -1146,10 +1345,18 @@ def run_multihost(
             )
             merged.errors, merged.read_errors = int(g[3]), int(g[4])
             if host_reports is not None:
+                from ..utils.metrics import _SPECS
+
                 summed: dict = {}
                 for h in host_reports:
                     for k, v in h["metrics"].items():
-                        summed[k] = summed.get(k, 0.0) + v
+                        # Counters sum across hosts; gauges (gang-agreed
+                        # values like the negotiated window depth) merge
+                        # by max so the report shows the value, not n x it.
+                        if _SPECS.get(k, ("counter",))[0] == "gauge":
+                            summed[k] = max(summed.get(k, v), v)
+                        else:
+                            summed[k] = summed.get(k, 0.0) + v
                 report = build_run_report(
                     values=summed,
                     wall_time_s=max(
@@ -1339,6 +1546,20 @@ def _run_elastic(
     n_rows = pq.ParquetFile(input_file).metadata.num_rows
     stride = math.ceil(n_rows / max(num_processes, 1))
 
+    # Overlapped stripe residue (PR 9): reuse the window config so each
+    # process keeps pipeline_depth stripe chunks in flight — one being
+    # processed/committed, the rest decoding on the prefetch thread.  Reads
+    # are side-effect-free, so fence/commit semantics are untouched and
+    # chunk boundaries stay at stripe order.
+    oc = getattr(config, "overlap", None)
+    read_ahead = 0
+    if (
+        oc is not None
+        and oc.enabled
+        and os.environ.get("TEXTBLAST_NO_OVERLAP") != "1"
+    ):
+        read_ahead = max(1, oc.pipeline_depth - 1)
+
     def window(s: int) -> Tuple[int, int]:
         # Identical striping to the lockstep path, computed from the input
         # alone — every process (and every relaunch) derives the same
@@ -1480,6 +1701,7 @@ def _run_elastic(
                     id_column=id_column,
                     record_dead=errors_file is not None,
                     on_chunk=on_chunk,
+                    read_ahead=read_ahead,
                 )
                 local.received += st.received - before[0]
                 local.success += st.success - before[1]
@@ -1584,6 +1806,17 @@ def _main(argv: Optional[Sequence[str]] = None) -> int:
         "relaunched ranks rejoin in place",
     )
     ap.add_argument(
+        "--pipeline-depth", type=int, default=None,
+        help="in-flight lockstep round window for THIS host; the joint "
+        "depth is the min over every host's value, allgathered once at "
+        "run start (cli.py run exposes the same flag)",
+    )
+    ap.add_argument(
+        "--no-overlap", action="store_true",
+        help="disable the overlapped pipeline on this host (negotiates "
+        "the whole gang down to serial depth 1)",
+    )
+    ap.add_argument(
         "--metrics-port", type=int, default=None,
         help="serve /metrics on this port + process-id (the offset keeps "
         "co-located processes from colliding on the bind)",
@@ -1614,6 +1847,10 @@ def _main(argv: Optional[Sequence[str]] = None) -> int:
         )
 
     config = load_pipeline_config(args.pipeline_config)
+    if args.no_overlap:
+        config.overlap.enabled = False
+    if args.pipeline_depth is not None:
+        config.overlap.pipeline_depth = max(1, args.pipeline_depth)
     try:
         result = run_multihost(
             config,

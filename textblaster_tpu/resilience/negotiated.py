@@ -142,15 +142,23 @@ class NegotiatedGuard:
         fetch: Callable[[object], Dict[str, np.ndarray]],
         inflight: Optional[object] = None,
         launch_fault: bool = False,
+        on_fault: Optional[Callable[[], None]] = None,
     ):
         """Resolve one lockstep round under the negotiated protocol.
 
         ``dispatch`` launches the round's global program (async) and
         ``fetch`` blocks for this process's host-side stats.  ``inflight``
-        carries an already-dispatched result tree (the one-round overlap in
+        carries an already-dispatched result tree (the in-flight window in
         ``run_local_shard``); ``launch_fault`` marks that the overlapped
         launch already raised a retryable error, so the first attempt goes
         straight to the verdict.
+
+        ``on_fault`` runs exactly once, on the FIRST joint fault verdict of
+        this round (before the retry/degradation branch) — the window-drain
+        hook: launched-ahead younger rounds must be discarded so every
+        host's global program order after the verdict is the same
+        ``[retry(r), r+1, r+2, ...]`` sequence.  The verdict is allgathered,
+        so every host invokes its hook at the identical point.
 
         Returns the fetched stats, or ``None`` when all hosts jointly
         degraded the round to the host oracle.  Fatal (deterministic)
@@ -186,6 +194,9 @@ class NegotiatedGuard:
                 {"bucket": bucket, "local_fault": local_fault,
                  "attempt": attempt, "epoch": self._epoch()},
             )
+            if on_fault is not None:
+                on_fault()
+                on_fault = None
             if attempt >= self.policy.max_retries:
                 METRICS.inc("resilience_negotiated_degraded_rounds_total")
                 TRACER.instant(

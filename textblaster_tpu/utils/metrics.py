@@ -214,6 +214,30 @@ _SPECS: Dict[str, Tuple[str, str]] = {
         "counter",
         "Liveness lease renewals posted by this process's heartbeat",
     ),
+    # Overlapped multi-host lockstep (parallel/multihost.py): the in-flight
+    # round window is negotiated once at run start (min over every host's
+    # pipeline_depth); these fold into the run report's resilience section
+    # like every multihost_* series.
+    "multihost_negotiated_depth": (
+        "gauge",
+        "Joint lockstep window depth: the min over every host's "
+        "--pipeline-depth, allgathered once at run start",
+    ),
+    "multihost_window_stall_seconds_total": (
+        "counter",
+        "Wall seconds blocked resolving the oldest in-flight lockstep "
+        "round (window full, or the end-of-phase drain)",
+    ),
+    "multihost_lockstep_seconds_total": (
+        "counter",
+        "Wall seconds inside the negotiated lockstep phase loop "
+        "(pack + dispatch + resolve), per host",
+    ),
+    "multihost_window_replayed_rounds_total": (
+        "counter",
+        "Launched-ahead lockstep rounds discarded and re-dispatched after "
+        "a negotiated fault verdict drained the window",
+    ),
     # Overlapped-pipeline stage accounting (no reference equivalent).  The
     # counters are wall seconds spent *inside* each stage, summed across
     # worker threads; with overlap on, stages run concurrently, so the sum
@@ -502,11 +526,18 @@ def resilience_report(
     baseline: Optional[Dict[str, float]] = None,
     values: Optional[Dict[str, float]] = None,
 ) -> Dict[str, int]:
-    """Every resilience/dead-letter/multihost counter as an int delta."""
+    """Every resilience/dead-letter/multihost counter as an int delta.
+
+    ``multihost_`` gauges (e.g. the negotiated window depth) ride along as
+    plain ints: they hold gang-agreed values, identical on every host, so
+    the merged report carries them without a delta interpretation."""
     delta = _delta_fn(baseline, values)
     out: Dict[str, int] = {}
     for name, (mtype, _help) in _SPECS.items():
-        if name.startswith(_RESILIENCE_REPORT_PREFIXES) and mtype == "counter":
+        if name.startswith(_RESILIENCE_REPORT_PREFIXES) and (
+            mtype == "counter"
+            or (mtype == "gauge" and name.startswith("multihost_"))
+        ):
             out[name] = int(delta(name))
     return out
 

@@ -8,7 +8,7 @@ overlaps them with device compute without changing a single outcome: the
 queues are strict FIFO, so ordering is identical to the serial path and
 only wall time moves.
 
-Two primitives live here:
+Three primitives live here:
 
 ``prefetch_iter``
     Wrap any iterator so a daemon thread runs it ahead of the consumer,
@@ -23,18 +23,25 @@ Two primitives live here:
     first write error is re-raised to the caller at the next call (or at
     ``close()``), preserving the serial path's error semantics; ``close()``
     drains the queue, joins the thread, and closes the inner writer.
+
+``shared_pack_pool``
+    The process-wide pack-worker ``ThreadPoolExecutor``.  Packing releases
+    the GIL (str.encode + numpy scatter), so one pool serves every call
+    site — ``CompiledPipeline``'s per-phase packer and the multi-host
+    lockstep window both submit here instead of spinning up private
+    executors per pipeline instance.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
-from typing import Any, Iterable, Iterator, List, Optional
+from typing import Any, Dict, Iterable, Iterator, List, Optional
 
 from .metrics import METRICS
 from .trace import TRACER
 
-__all__ = ["prefetch_iter", "ThreadedWriter"]
+__all__ = ["prefetch_iter", "ThreadedWriter", "shared_pack_pool"]
 
 #: Queue sentinel: the producer finished cleanly.
 _DONE = object()
@@ -131,6 +138,37 @@ def prefetch_iter(source: Iterable, depth: int = 4, block: int = 256):
     hot path.
     """
     return _PrefetchIterator(source, depth=depth, block=block)
+
+
+#: Process-wide pack pools, keyed by worker count (executors cannot grow,
+#: so distinct ``pack_workers`` settings get distinct pools; in practice a
+#: process uses one setting and therefore one pool).
+_PACK_POOLS: Dict[int, Any] = {}
+_PACK_POOLS_LOCK = threading.Lock()
+
+
+def shared_pack_pool(workers: int = 2):
+    """The process-wide pack-worker pool for ``workers`` threads.
+
+    Reused across every call site (single-host phase packers, the
+    multi-host lockstep window, tests) — pack work is short-lived and
+    GIL-releasing, so sharing one executor avoids a thread-pool per
+    ``CompiledPipeline`` while keeping submission order = completion
+    consumption order for any caller that resolves its own futures FIFO.
+    Never shut down explicitly: workers are idle between submissions and
+    the interpreter joins them at exit.
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    w = max(1, int(workers))
+    with _PACK_POOLS_LOCK:
+        pool = _PACK_POOLS.get(w)
+        if pool is None:
+            pool = ThreadPoolExecutor(
+                max_workers=w, thread_name_prefix="textblast-pack"
+            )
+            _PACK_POOLS[w] = pool
+        return pool
 
 
 class ThreadedWriter:
