@@ -1051,7 +1051,10 @@ def main() -> int:
     # parity between the two arms must be 1.0 — the window is a scheduling
     # change, not a semantic one.
     mh_overlap_report = None
-    if os.environ.get("BENCH_MULTIHOST_OVERLAP", "1") != "0":
+    mh_reform_report = None
+    _mh_overlap_on = os.environ.get("BENCH_MULTIHOST_OVERLAP", "1") != "0"
+    _mh_reform_on = os.environ.get("BENCH_REFORM", "0") == "1"
+    if _mh_overlap_on or _mh_reform_on:
         import socket
         import tempfile
 
@@ -1133,23 +1136,28 @@ pipeline:
         def _mh_rows(path):
             return pq.read_table(path).to_pylist() if os.path.exists(path) else []
 
+        def _mh_input(root, n=192):
+            picked = [d for d in docs if len(d.content) <= 2040][:n]
+            with open(os.path.join(root, "cfg.yaml"), "w",
+                      encoding="utf-8") as f:
+                f.write(_MH_YAML)
+            inp = os.path.join(root, "input.parquet")
+            pq.write_table(
+                pa.table(
+                    {
+                        "id": [d.id for d in picked],
+                        "text": [d.content for d in picked],
+                        "source": [d.source or "bench" for d in picked],
+                    }
+                ),
+                inp,
+            )
+            return picked, inp
+
+    if _mh_overlap_on:
         try:
             with tempfile.TemporaryDirectory(prefix="bench-mh-") as root:
-                with open(os.path.join(root, "cfg.yaml"), "w",
-                          encoding="utf-8") as f:
-                    f.write(_MH_YAML)
-                mh_docs = [d for d in docs if len(d.content) <= 2040][:192]
-                inp = os.path.join(root, "input.parquet")
-                pq.write_table(
-                    pa.table(
-                        {
-                            "id": [d.id for d in mh_docs],
-                            "text": [d.content for d in mh_docs],
-                            "source": [d.source or "bench" for d in mh_docs],
-                        }
-                    ),
-                    inp,
-                )
+                mh_docs, inp = _mh_input(root)
                 _mh_pass(root, inp, "warm", ["--pipeline-depth", "1"])
                 se_rep, se_out, se_exc = _mh_pass(
                     root, inp, "serial",
@@ -1217,6 +1225,67 @@ pipeline:
         except Exception as e:  # never bill a 2-proc spawn problem to the bench
             mh_overlap_report = {"error": f"{type(e).__name__}: {e}"[:500]}
             _log(f"multihost overlap A/B skipped: {e}")
+
+    # --- Exchange-transport A/B (BENCH_REFORM=1 enables; off by default —
+    # four 2-proc runs).  Fault-free coordinated runs, the default XLA/KV
+    # funnel vs the file-lease transport (--exchange-transport file), same
+    # input, same pipeline.  The file transport trades coordination-service
+    # KV round-trips for shared-filesystem polling; this measures what that
+    # costs per run when nothing dies — the steady-state price of carrying
+    # the gang-reformation machinery.  Outputs must be ordered-identical
+    # (the transport moves bytes, not decisions) and the fault-free file
+    # arm must report zero reformations, else the deadline/lease-ttl
+    # defaults are too tight for this box.
+    if _mh_reform_on:
+        try:
+            with tempfile.TemporaryDirectory(prefix="bench-reform-") as root:
+                rf_docs, inp = _mh_input(root)
+                # One untimed warm run per arm: the kv arm compiles under
+                # jax.distributed's global mesh while the file arm never
+                # initializes it and compiles collective-free local
+                # programs — different executables, so each arm has to
+                # populate its own AOT cache entries.
+                _mh_pass(root, inp, "warm-kv", ["--exchange-transport", "kv"])
+                _mh_pass(
+                    root, inp, "warm-file", ["--exchange-transport", "file"]
+                )
+                kv_rep, kv_out, kv_exc = _mh_pass(
+                    root, inp, "kv", ["--exchange-transport", "kv"]
+                )
+                fl_rep, fl_out, fl_exc = _mh_pass(
+                    root, inp, "file", ["--exchange-transport", "file"]
+                )
+                kv_rate, kv_s = _mh_rate(kv_rep)
+                fl_rate, fl_s = _mh_rate(fl_rep)
+                kv_rows = (_mh_rows(kv_out), _mh_rows(kv_exc))
+                fl_rows = (_mh_rows(fl_out), _mh_rows(fl_exc))
+                fl_res = fl_rep.get("resilience", {})
+                mh_reform_report = {
+                    "kv_docs_per_sec": round(kv_rate, 2),
+                    "file_docs_per_sec": round(fl_rate, 2),
+                    "file_over_kv": (
+                        round(fl_rate / kv_rate, 4) if kv_rate else 0.0
+                    ),
+                    "ordered_identical": kv_rows == fl_rows,
+                    "lockstep_s": {
+                        "kv": round(kv_s, 3),
+                        "file": round(fl_s, 3),
+                    },
+                    "file_reformations": int(
+                        fl_res.get("multihost_gang_reformations_total", 0)
+                    ),
+                    "n_docs": len(rf_docs),
+                    "processes": 2,
+                }
+                _log(
+                    f"exchange transport: file {fl_rate:.1f} docs/s vs kv "
+                    f"{kv_rate:.1f} (x{mh_reform_report['file_over_kv']}, "
+                    f"ordered={mh_reform_report['ordered_identical']}, "
+                    f"reformations={mh_reform_report['file_reformations']})"
+                )
+        except Exception as e:  # never bill a 2-proc spawn problem to the bench
+            mh_reform_report = {"error": f"{type(e).__name__}: {e}"[:500]}
+            _log(f"exchange transport A/B skipped: {e}")
 
     # --- Tracing overhead, A/B (BENCH_TRACE=0 skips).  The span tracer is
     # a single attribute check when off; when on it adds two clock reads +
@@ -1365,6 +1434,10 @@ pipeline:
         # negotiated window depth, window stall seconds, and decision
         # parity between the arms (must be 1.0 — scheduling, not semantics).
         **({"multihost_overlap": mh_overlap_report} if mh_overlap_report else {}),
+        # KV-vs-file exchange-transport A/B (BENCH_REFORM=1): the fault-free
+        # steady-state cost of the gang-reformation carrier, with ordered
+        # output parity and a zero-reformation sanity gate.
+        **({"exchange_transport": mh_reform_report} if mh_reform_report else {}),
         # Trace on/off A/B over the device path: the span tracer must stay
         # within ~2% of the untraced rate when on and free when off.
         **({"trace": trace_report} if trace_report else {}),

@@ -1,0 +1,671 @@
+"""Gang reformation suite (PR 10): pluggable exchange transport + survival.
+
+Four layers, mirroring how the reformation machinery can fail:
+
+* **Unit** (fast): transport resolution rules, the default-path pin (no new
+  flags => no transport installed, the KV funnel byte-for-byte), the
+  file-lease allgather roundtrip + drained-slot GC, fenced-zombie post
+  rejection, the ``complete`` cursor flag, fault-site armability, and the
+  CLI flag validations (deadline/TTL pair, kv+survive contradiction,
+  elastic incompatibility, coordinator requirement).
+* **Reformation protocol** (fast, in-process): a 2-member transport whose
+  peer never posts reforms to a solo gang (typed :exc:`GangReformed`,
+  fence table populated, metrics bumped, exchange epoch bumped, solo
+  replay working), a double death (reform to 1, then lose the filesystem
+  lease) fails typed instead of hanging, and the election is deterministic
+  — both stores compute the identical member set from the shared
+  fence/proposal tables.
+* **2-process chaos** (slow): a real SIGKILL of rank 1 mid-window on the
+  coordinated file-transport path under ``--survive-peer-loss`` — rank 0
+  must fence it, reform to a solo gang, adopt and reproduce its stripe,
+  and merge outputs byte-identical to a fault-free single-host run, with
+  ``multihost_gang_reformations_total == 1`` in the merged run report.
+* **2-process fault injection** (slow): the deterministic twin — rank 1
+  dies of an armed ``multihost.exchange.post`` fault (its slot for that
+  exchange never appears), exercising the same reformation path without
+  kill-timing races.
+
+The spawn helpers are standalone copies of tests/test_multihost_chaos.py's
+(same env contract) — importing across test modules would couple the
+suites' lifecycles.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import select
+import signal
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from textblaster_tpu.checkpoint import CheckpointState
+from textblaster_tpu.cli import build_parser, main as cli_main
+from textblaster_tpu.data_model import TextDocument
+from textblaster_tpu.errors import (
+    GangReformed,
+    PeerFailure,
+    PipelineError,
+    ReformationFailed,
+)
+from textblaster_tpu.parallel import multihost
+from textblaster_tpu.resilience import FAULTS
+from textblaster_tpu.resilience.membership import (
+    FileMembershipStore,
+    elect_members,
+)
+from textblaster_tpu.utils.metrics import METRICS
+
+REPO = Path(__file__).parent.parent
+
+YAML = """
+pipeline:
+  - type: LanguageDetectionFilter
+    min_confidence: 0.5
+    allowed_languages: [ "dan", "eng" ]
+  - type: GopherQualityFilter
+    min_doc_words: 4
+    min_stop_words: 1
+    stop_words: [ "og", "the", "er", "i" ]
+"""
+
+
+def _docs(n=48):
+    base = [
+        "Det er en god dag i dag, og vi skal ud at gå en lang tur i skoven nu.",
+        "The quick brown fox jumps over the lazy dog and the old stone bridge.",
+        "kort.",
+        "Endnu en dansk tekst om vejret, og den er ganske lang og fin at læse.",
+        "Vi mødes nede ved havnen i morgen, og så sejler vi ud på vandet.",
+        ("En meget lang dansk tekst om byen og havnen og vejret, og den "
+         "bliver ved i mange ord. ") * 12,
+    ]
+    rng = np.random.default_rng(11)
+    docs = []
+    for i in range(n):
+        t = base[i % len(base)]
+        if rng.random() < 0.25:
+            t = t + " Og lidt mere tekst til sidst her."
+        docs.append(TextDocument(id=f"gr-{i}", source="s", content=t))
+    return docs
+
+
+@pytest.fixture()
+def _exchange_state():
+    """Reset the module-global exchange state (incl. installed transport)
+    around a test — `reset=True` with no transport restores the default
+    KV funnel."""
+    multihost.configure_exchange(deadline_s=300.0, reset=True)
+    yield multihost._EXCHANGE
+    multihost.configure_exchange(deadline_s=300.0, reset=True)
+
+
+# --- transport resolution ----------------------------------------------------
+
+
+def test_resolve_exchange_transport_rules():
+    assert multihost.resolve_exchange_transport("auto", False) == "kv"
+    assert multihost.resolve_exchange_transport("auto", True) == "file"
+    assert multihost.resolve_exchange_transport("file", False) == "file"
+    assert multihost.resolve_exchange_transport("file", True) == "file"
+    assert multihost.resolve_exchange_transport("kv", False) == "kv"
+    assert multihost.resolve_exchange_transport("KV", False) == "kv"
+    with pytest.raises(PipelineError, match="survive-peer-loss"):
+        multihost.resolve_exchange_transport("kv", True)
+    with pytest.raises(PipelineError, match="auto/kv/file"):
+        multihost.resolve_exchange_transport("carrier-pigeon", False)
+
+
+def test_default_path_pins_kv_transport(_exchange_state):
+    """The PR 9 byte-parity pin: without the new flags no transport is
+    installed, `host_allgather` routes through the module-level KV funnel
+    (whose n==1 shortcut returns the caller's row verbatim), and no
+    membership/slot files are involved at all."""
+    assert _exchange_state.transport is None
+    assert isinstance(multihost._KV_TRANSPORT, multihost.KVExchangeTransport)
+    assert multihost._KV_TRANSPORT.name == "kv"
+    out = multihost.host_allgather(np.array([3, 1, 4], dtype=np.int64))
+    assert out.tolist() == [[3, 1, 4]]
+    # configure_exchange without `transport` keeps the default installed
+    # (None), including through resets.
+    multihost.configure_exchange(deadline_s=12.0)
+    assert _exchange_state.transport is None
+    assert _exchange_state.deadline_s == 12.0
+
+
+# --- checkpoint cursor: the adoption completion marker -----------------------
+
+
+def test_cursor_complete_flag_roundtrip_and_legacy_load(tmp_path):
+    d = str(tmp_path)
+    fp = {"path": "/in.parquet", "size": 1, "mtime_ns": 2, "num_rows": 48}
+    st = CheckpointState.adopt(d, {"rank": 0, "incarnation": "x"},
+                               input_fingerprint=fp, config_hash="h")
+    assert st.complete is False
+    st.rows_consumed, st.complete = 24, True
+    st.save(d)
+    st2 = CheckpointState.load(d)
+    assert st2.complete is True and st2.rows_consumed == 24
+    # A pre-PR-10 cursor (no "complete" key) loads with the safe default.
+    p = Path(d) / "checkpoint.json"
+    doc = json.loads(p.read_text(encoding="utf-8"))
+    del doc["complete"]
+    p.write_text(json.dumps(doc), encoding="utf-8")
+    st3 = CheckpointState.load(d)
+    assert st3 is not None and st3.complete is False
+
+
+# --- file-lease allgather ----------------------------------------------------
+
+
+def test_file_allgather_roundtrip_and_slot_gc(tmp_path, _exchange_state):
+    """Two-member exchange driven single-threaded: the peer's slots are
+    pre-posted, so rank 0's blocking read completes immediately — and
+    completing exchange s proves s-1 was read, so rank 0's own s-1 slot
+    must be gone afterwards (the KV hygiene rule, mirrored)."""
+    root = str(tmp_path / "membership")
+    s0 = FileMembershipStore(root, 0, ttl_s=30.0)
+    s1 = FileMembershipStore(root, 1, ttl_s=30.0)
+    s0.register()
+    s1.register()
+    ft = multihost.FileLeaseTransport(s0, 0, 2, survive=False)
+    multihost.configure_exchange(
+        deadline_s=5.0, lease_store=s0, transport=ft
+    )
+    assert _exchange_state.transport is ft
+    assert ft.members() == (0, 1)
+
+    s1.post_exchange_slot(0, 0, "3,4")
+    out = multihost.host_allgather(np.array([1, 2]))
+    assert out.tolist() == [[1, 2], [3, 4]]
+
+    s1.post_exchange_slot(0, 1, "7,8")
+    out = multihost.host_allgather(np.array([5, 6]))
+    assert out.tolist() == [[5, 6], [7, 8]]
+    # Drained-slot GC: rank 0 deleted its OWN s0 slot after s1 completed;
+    # rank 1's s0 slot is rank 1's to delete (each rank cleans its own).
+    assert not os.path.exists(
+        os.path.join(root, "exchange", "e0", "s0", "rank0.json")
+    )
+    assert os.path.exists(
+        os.path.join(root, "exchange", "e0", "s0", "rank1.json")
+    )
+    assert METRICS.get("multihost_file_exchange_posts_total") > 0
+
+
+def test_fenced_zombie_post_is_ignored(tmp_path, _exchange_state):
+    """A fence on rank 1's incarnation makes its (late) slot post invisible:
+    rank 0's exchange must NOT consume it, and — without survive — the
+    deadline expiry raises the same typed PeerFailure as a silent peer."""
+    root = str(tmp_path / "membership")
+    s0 = FileMembershipStore(root, 0, ttl_s=30.0)
+    s1 = FileMembershipStore(root, 1, ttl_s=30.0)
+    s0.register()
+    s1.register()
+    inc, newly = s0.fence_rank(1)
+    assert newly is True and inc == s1.incarnation
+    _, again = s0.fence_rank(1)
+    assert again is False  # write-once: the second fencer loses harmlessly
+    assert s1.self_fenced()
+    s1.post_exchange_slot(0, 0, "9,9")  # the zombie posts anyway
+    ft = multihost.FileLeaseTransport(s0, 0, 2, survive=False)
+    multihost.configure_exchange(
+        deadline_s=0.3, lease_store=s0, transport=ft
+    )
+    with pytest.raises(PeerFailure) as ei:
+        multihost.host_allgather(np.array([1, 2]))
+    assert ei.value.missing_ranks == (1,)
+    assert "never appeared" in str(ei.value)
+
+
+# --- reformation protocol ----------------------------------------------------
+
+
+def test_solo_reform_then_double_death(tmp_path, _exchange_state):
+    """Rank 1 never registers: the first exchange's deadline expiry under
+    survive=True must fence it, reform to a solo gang (typed GangReformed,
+    metrics bumped, exchange epoch bumped), and solo exchanges must then
+    work — until rank 0's own lease disappears (double death), which the
+    per-exchange self-check turns into a typed ReformationFailed instead
+    of a hang on slots no peer can ever fill."""
+    root = str(tmp_path / "membership")
+    s0 = FileMembershipStore(root, 0, ttl_s=10.0)
+    s0.register()
+    ft = multihost.FileLeaseTransport(s0, 0, 2, survive=True)
+    multihost.configure_exchange(
+        deadline_s=0.5, lease_store=s0, transport=ft
+    )
+    reforms_before = METRICS.get("multihost_gang_reformations_total")
+    fenced_before = METRICS.get("multihost_fenced_ranks_total")
+    with pytest.raises(GangReformed) as ei:
+        multihost.host_allgather(np.array([7]))
+    assert tuple(ei.value.members) == (0,)
+    assert tuple(ei.value.dead_ranks) == (1,)
+    assert ft.members() == (0,)
+    assert ft.dead_ranks == [1]
+    assert ft.reformations == 1
+    assert s0.is_fenced(1, "any")
+    assert METRICS.get("multihost_gang_reformations_total") - reforms_before == 1
+    assert METRICS.get("multihost_fenced_ranks_total") - fenced_before == 1
+    assert multihost.current_exchange_epoch() == 1
+    # The driver replays the interrupted exchange over the survivor set.
+    assert multihost.host_allgather(np.array([5])).tolist() == [[5]]
+    assert multihost.host_allgather_obj({"x": 1}) == [{"x": 1}]
+    # Double death: the survivor's own lease vanishes (filesystem lost).
+    os.remove(os.path.join(root, "lease.rank0.json"))
+    with pytest.raises(ReformationFailed) as ei2:
+        multihost.host_allgather(np.array([9]))
+    assert ei2.value.rank == 0
+    assert "stale or gone" in str(ei2.value)
+
+
+def test_stale_own_lease_is_renewed_not_fatal(tmp_path, _exchange_state):
+    """A stale-but-present lease of this very incarnation is a scheduling
+    artifact (a GIL-holding XLA compile can starve the heartbeat thread
+    past the TTL), not a death: the per-exchange self-check must renew it
+    in place and carry on.  Gone stays fatal (the double-death test
+    above); overwritten by a successor incarnation stays fatal too —
+    renewal must not steal the lease back from the replacement launch."""
+    root = str(tmp_path / "membership")
+    s0 = FileMembershipStore(root, 0, ttl_s=10.0)
+    s0.register()
+    ft = multihost.FileLeaseTransport(s0, 0, 1, survive=True)
+    multihost.configure_exchange(
+        deadline_s=5.0, lease_store=s0, transport=ft
+    )
+    lease = os.path.join(root, "lease.rank0.json")
+    with open(lease, encoding="utf-8") as f:
+        d = json.load(f)
+    d["time"] -= 3600.0  # far past the 10s TTL, same incarnation
+    with open(lease, "w", encoding="utf-8") as f:
+        json.dump(d, f)
+    assert not s0.my_lease_fresh()
+    assert multihost.host_allgather(np.array([4, 2])).tolist() == [[4, 2]]
+    assert s0.my_lease_fresh()  # renewed in place by the self-check
+    # A successor incarnation registered over this rank's lease: this
+    # launch was replaced and must terminate typed, leaving the
+    # successor's lease untouched.
+    usurper = FileMembershipStore(root, 0, ttl_s=10.0)
+    usurper.register()
+    with pytest.raises(ReformationFailed) as ei:
+        multihost.host_allgather(np.array([9]))
+    assert "stale or gone" in str(ei.value)
+    assert s0.read_leases()[0]["incarnation"] == usurper.incarnation
+
+
+def test_election_is_deterministic_across_stores(tmp_path):
+    """Both survivors must elect the identical member set from the shared
+    fence/proposal tables — here driven single-threaded by pre-posting
+    rank 1's attempt-0 proposal, then running rank 0's election (which
+    posts its own), then rank 1's against the now-complete tables."""
+    root = str(tmp_path / "membership")
+    s0 = FileMembershipStore(root, 0, ttl_s=30.0)
+    s1 = FileMembershipStore(root, 1, ttl_s=30.0)
+    s0.register()
+    s1.register()
+    # Rank 2 is the suspect (never registered).  Rank 1 already fenced it
+    # and posted its attempt-0 proposal, as a real survivor blocked at the
+    # same (epoch, seq) would have.
+    s1.fence_rank(2)
+    s1.post_proposal("e0s5.a0", [0, 1])
+    m0, dead0 = elect_members(s0, [0, 1, 2], [2], tag="e0s5", deadline_s=2.0)
+    m1, dead1 = elect_members(s1, [0, 1, 2], [2], tag="e0s5", deadline_s=2.0)
+    assert m0 == m1 == (0, 1)
+    assert dead0 == dead1 == (2,)
+    assert s0.is_fenced(2, "any")
+    # A fenced rank cannot run the election at all — safety over liveness.
+    s0.fence_rank(1)
+    with pytest.raises(ReformationFailed):
+        elect_members(s1, [0, 1], [], tag="e0s6", deadline_s=0.5)
+
+
+# --- fault sites -------------------------------------------------------------
+
+
+def test_reform_fault_sites_are_armable(tmp_path):
+    store = FileMembershipStore(str(tmp_path / "m"), 0, ttl_s=30.0)
+    store.register()
+    FAULTS.inject("multihost.exchange.post", OSError("injected post outage"))
+    try:
+        with pytest.raises(OSError):
+            store.post_exchange_slot(0, 0, "1")
+    finally:
+        FAULTS.reset()
+    store.post_exchange_slot(0, 0, "1")  # disarmed: posts work again
+    FAULTS.inject("multihost.reform", OSError("injected election outage"))
+    try:
+        with pytest.raises(OSError):
+            elect_members(store, [0, 1], [1], tag="t", deadline_s=0.5)
+    finally:
+        FAULTS.reset()
+
+
+# --- CLI flag surface --------------------------------------------------------
+
+
+def test_cli_parses_reform_flags():
+    args = build_parser().parse_args(
+        ["run", "-i", "x.parquet", "--coordinator", "localhost:1",
+         "--exchange-transport", "file", "--survive-peer-loss"]
+    )
+    assert args.exchange_transport == "file"
+    assert args.survive_peer_loss is True
+    args = build_parser().parse_args(["run", "-i", "x.parquet"])
+    assert args.exchange_transport == "auto"
+    assert args.survive_peer_loss is False
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(
+            ["run", "-i", "x", "--exchange-transport", "telegraph"]
+        )
+
+
+def test_cli_reform_flags_require_coordinator(capsys):
+    assert cli_main(["run", "-i", "x.parquet", "--survive-peer-loss"]) == 1
+    assert "require --coordinator" in capsys.readouterr().err
+    assert cli_main(
+        ["run", "-i", "x.parquet", "--exchange-transport", "file"]
+    ) == 1
+    assert "require --coordinator" in capsys.readouterr().err
+
+
+def test_cli_survive_rejects_kv_transport(capsys):
+    rc = cli_main(
+        ["run", "-i", "x.parquet", "--coordinator", "localhost:1",
+         "--survive-peer-loss", "--exchange-transport", "kv"]
+    )
+    assert rc == 1
+    assert "file-lease exchange transport" in capsys.readouterr().err
+
+
+def test_cli_elastic_rejects_reform_flags(capsys):
+    rc = cli_main(
+        ["run", "-i", "x.parquet", "--coordinator", "localhost:1",
+         "--elastic", "--survive-peer-loss"]
+    )
+    assert rc == 1
+    assert "--elastic is incompatible" in capsys.readouterr().err
+
+
+def test_cli_exchange_deadline_must_exceed_lease_ttl(capsys):
+    rc = cli_main(
+        ["run", "-i", "x.parquet", "--coordinator", "localhost:1",
+         "--exchange-deadline-s", "5", "--lease-ttl-s", "10"]
+    )
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert "5s" in err and "10s" in err and "must exceed" in err
+    # Equal is as wrong as under — and the check fills in library defaults
+    # (deadline 300 vs an explicit TTL of 400 must still fail).
+    rc = cli_main(
+        ["run", "-i", "x.parquet", "--coordinator", "localhost:1",
+         "--lease-ttl-s", "400"]
+    )
+    assert rc == 1
+    assert "must exceed" in capsys.readouterr().err
+
+
+# --- 2-process chaos ---------------------------------------------------------
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_rank(tmp_path, pid, port, extra_args=(), env_extra=None):
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        "PATH": "/usr/bin:/bin:/usr/local/bin",
+        "HOME": "/root",
+    }
+    if env_extra:
+        env.update(env_extra)
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "textblaster_tpu.cli", "run",
+            "--coordinator", f"localhost:{port}",
+            "--num-processes", "2",
+            "--process-id", str(pid),
+            "-i", str(tmp_path / "input.parquet"),
+            "-o", str(tmp_path / "kept.parquet"),
+            "-e", str(tmp_path / "excluded.parquet"),
+            "-c", str(tmp_path / "cfg.yaml"),
+            "--buckets", "512,2048",
+            "--quiet",
+            *extra_args,
+        ],
+        cwd=str(REPO),
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+
+def _drain(proc, sink, timeout):
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+    if out:
+        sink.append(out)
+    return "".join(sink)
+
+
+def _write_input(dirpath, docs, null_text_rows=()):
+    inp = dirpath / "input.parquet"
+    nulls = set(null_text_rows)
+    pq.write_table(
+        pa.table(
+            {
+                "id": [d.id for d in docs],
+                "text": [
+                    None if i in nulls else d.content
+                    for i, d in enumerate(docs)
+                ],
+                "source": [d.source for d in docs],
+            }
+        ),
+        inp,
+    )
+    return inp
+
+
+def _rows(path):
+    return {
+        r["id"]: (
+            r["text"],
+            json.loads(r["metadata"]) if r["metadata"] else {},
+        )
+        for r in pq.read_table(path).to_pylist()
+    }
+
+
+def _single_host_reference(tmp_path, docs, null_text_rows=()):
+    """Fault-free single-host CLI run — the byte-parity reference."""
+    ref = tmp_path / "ref"
+    ref.mkdir()
+    (ref / "cfg.yaml").write_text(YAML, encoding="utf-8")
+    _write_input(ref, docs, null_text_rows)
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "textblaster_tpu.cli", "run",
+            "-i", str(ref / "input.parquet"),
+            "-o", str(ref / "kept.parquet"),
+            "-e", str(ref / "excluded.parquet"),
+            "-c", str(ref / "cfg.yaml"),
+            "--buckets", "512,2048",
+            "--errors-file", str(ref / "errors.parquet"),
+            "--quiet",
+        ],
+        cwd=str(REPO),
+        env={
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "PATH": "/usr/bin:/bin:/usr/local/bin",
+            "HOME": "/root",
+        },
+        capture_output=True, text=True, timeout=560,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    return ref / "kept.parquet", ref / "excluded.parquet", ref / "errors.parquet"
+
+
+def _posted_slots(membership_root, rank, seen) -> int:
+    """Accumulate every (epoch, seq) exchange slot ``rank`` has ever been
+    seen to post into ``seen`` — the chaos tests' kill-synchronization
+    signal.  Slots are GC'd one exchange later and the exchange epoch
+    advances at every phase boundary, so progress is counted across
+    epochs from a frequent poll, not read from one directory."""
+    for p in glob.glob(
+        os.path.join(membership_root, "exchange", "e*", "s*",
+                     f"rank{rank}.json")
+    ):
+        m = re.search(r"[/\\]e(\d+)[/\\]s(\d+)[/\\]", p)
+        if m:
+            seen.add((int(m.group(1)), int(m.group(2))))
+    return len(seen)
+
+
+def _assert_reformed_run_matches_reference(tmp_path, docs, nulls, out0):
+    assert re.search(r"reform\[0\]: exchange e\d+/s\d+ deadline", out0), \
+        out0[-3000:]
+    assert "reformed to members [0]" in out0
+    assert "adopting dead rank 1's stripe" in out0
+    assert "Gang reformation: survived 1 peer-loss event(s)" in out0
+    assert not os.path.exists(str(tmp_path / "kept.parquet.membership"))
+
+    report = json.loads(
+        (tmp_path / "report.json").read_text(encoding="utf-8")
+    )
+    res = report["resilience"]
+    assert res["multihost_gang_reformations_total"] == 1
+    assert res["multihost_fenced_ranks_total"] == 1
+    assert res["multihost_adopted_stripes_total"] == 1
+    assert report["counts"]["received"] == len(docs) - len(nulls)
+    assert report["counts"]["read_errors"] == len(nulls)
+    assert report["num_hosts"] == 1  # only the survivor contributed a row
+
+    ref_out, ref_exc, ref_err = _single_host_reference(tmp_path, docs, nulls)
+    assert _rows(tmp_path / "kept.parquet") == _rows(ref_out)
+    assert _rows(tmp_path / "excluded.parquet") == _rows(ref_exc)
+    err_rows = pq.read_table(tmp_path / "errors.parquet").to_pylist()
+    ref_err_rows = pq.read_table(ref_err).to_pylist()
+    assert len(err_rows) == len(nulls) == len(ref_err_rows)
+    assert sorted(r["step"] for r in err_rows) == sorted(
+        r["step"] for r in ref_err_rows
+    )
+
+
+REFORM_ARGS = (
+    "--survive-peer-loss",
+    "--exchange-deadline-s", "6", "--lease-ttl-s", "2",
+    "--batch-size", "8",
+)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.reform
+def test_reform_sigkill_survivor_adopts_and_matches_single_host(tmp_path):
+    """The ISSUE acceptance scenario: SIGKILL rank 1 mid-window on the
+    file-transport coordinated path under ``--survive-peer-loss``.  Rank 0
+    must hit the exchange deadline, fence rank 1, reform to a solo gang,
+    adopt and reproduce its stripe, and finish with merged outputs
+    byte-identical to a fault-free single-host run — with exactly one
+    reformation in the merged run report."""
+    docs = _docs(256)
+    nulls = (3, 140)  # one unreadable row per stripe
+    (tmp_path / "cfg.yaml").write_text(YAML, encoding="utf-8")
+    _write_input(tmp_path, docs, nulls)
+    membership_root = str(tmp_path / "kept.parquet.membership")
+    port = _free_port()
+    args = REFORM_ARGS + (
+        "--errors-file", str(tmp_path / "errors.parquet"),
+        "--run-report", str(tmp_path / "report.json"),
+    )
+    p0 = _spawn_rank(tmp_path, 0, port, args)
+    p1 = _spawn_rank(tmp_path, 1, port, args)
+    sink0, sink1 = [], []
+    try:
+        # Kill rank 1 once its exchange slots show the lockstep rounds are
+        # underway (mid-window), watched through the membership dir itself.
+        deadline = time.monotonic() + 420
+        killed = False
+        seen: set = set()
+        while time.monotonic() < deadline:
+            if _posted_slots(membership_root, 1, seen) >= 6:
+                if p1.poll() is None:
+                    os.kill(p1.pid, signal.SIGKILL)
+                    killed = True
+                break
+            if p1.poll() is not None or p0.poll() is not None:
+                break
+            time.sleep(0.01)
+        if not killed:
+            pytest.skip(
+                "rank 1 finished before the kill could land mid-window:\n"
+                + _drain(p1, sink1, timeout=30)[-1500:]
+            )
+        out0 = _drain(p0, sink0, timeout=420)
+        assert p0.returncode == 0, out0[-4000:]
+    finally:
+        for p in (p0, p1):
+            if p.poll() is None:
+                p.kill()
+        _drain(p1, sink1, timeout=30)
+
+    _assert_reformed_run_matches_reference(tmp_path, docs, nulls, out0)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.reform
+def test_reform_on_injected_post_fault_is_deterministic(tmp_path):
+    """The race-free twin of the SIGKILL test: rank 1 dies of an armed
+    ``multihost.exchange.post`` fault (TEXTBLAST_FAULTS, gated to rank 1),
+    so its slot for that exchange deterministically never appears and
+    rank 0 reforms around it — same assertions, no kill timing."""
+    docs = _docs(256)
+    nulls = (3, 140)
+    (tmp_path / "cfg.yaml").write_text(YAML, encoding="utf-8")
+    _write_input(tmp_path, docs, nulls)
+    port = _free_port()
+    args = REFORM_ARGS + (
+        "--errors-file", str(tmp_path / "errors.parquet"),
+        "--run-report", str(tmp_path / "report.json"),
+    )
+    p0 = _spawn_rank(tmp_path, 0, port, args)
+    p1 = _spawn_rank(
+        tmp_path, 1, port, args,
+        env_extra={
+            "TEXTBLAST_FAULTS": "multihost.exchange.post:after=8:times=99",
+            "TEXTBLAST_FAULTS_PROCESS": "1",
+        },
+    )
+    sink0, sink1 = [], []
+    try:
+        out0 = _drain(p0, sink0, timeout=420)
+        out1 = _drain(p1, sink1, timeout=60)
+        assert p1.returncode != 0, out1[-2000:]  # the armed rank died
+        assert "injected fault at multihost.exchange.post" in out1
+        assert p0.returncode == 0, out0[-4000:]
+    finally:
+        for p in (p0, p1):
+            if p.poll() is None:
+                p.kill()
+
+    _assert_reformed_run_matches_reference(tmp_path, docs, nulls, out0)

@@ -128,6 +128,13 @@ class CheckpointState:
     # rewrites it (:meth:`adopt`).  Absent in single-host cursors (None), so
     # the default keeps old cursors loadable.
     owner: Optional[dict] = None
+    # Coordinated-path adoption marker (parallel/multihost.py
+    # --survive-peer-loss): True once an adopter has fully reproduced a
+    # dead rank's stripe and committed its shard files, so a later
+    # re-adoption (the adopter itself died) skips the stripe instead of
+    # repeating it.  Absent in older cursors (False), so the default keeps
+    # them loadable.
+    complete: bool = False
     version: int = _VERSION
 
     def save(

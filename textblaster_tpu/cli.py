@@ -178,6 +178,21 @@ def build_parser() -> argparse.ArgumentParser:
                           "adopt a dead rank's stripe, and a relaunched "
                           "rank rejoins in place replaying no completed "
                           "work")
+    run.add_argument("--exchange-transport", default="auto",
+                     choices=("auto", "kv", "file"),
+                     help="With --coordinator: carrier for the lockstep "
+                          "exchanges — kv = the XLA/coordination-service "
+                          "funnel (fastest, dies with its peers), file = "
+                          "shared-filesystem slots riding the membership "
+                          "leases (required for --survive-peer-loss); "
+                          "auto picks file iff --survive-peer-loss")
+    run.add_argument("--survive-peer-loss", action="store_true",
+                     help="With --coordinator: gang reformation — on a "
+                          "peer death the survivors fence the dead rank's "
+                          "incarnation, re-elect the member set, adopt "
+                          "its stripe, and finish the run with outputs "
+                          "byte-identical to a fault-free run (file "
+                          "exchange transport only)")
 
     val = sub.add_parser("validate-config",
                          help="Validate a pipeline configuration and exit")
@@ -306,10 +321,13 @@ def _cmd_run(args: argparse.Namespace) -> int:
         return 1
     if not args.coordinator and (
         args.elastic
+        or args.survive_peer_loss
+        or args.exchange_transport != "auto"
         or args.exchange_deadline_s is not None
         or args.lease_ttl_s is not None
     ):
-        print("--elastic / --exchange-deadline-s / --lease-ttl-s shape the "
+        print("--elastic / --survive-peer-loss / --exchange-transport / "
+              "--exchange-deadline-s / --lease-ttl-s shape the "
               "multi-host membership layer and require --coordinator",
               file=sys.stderr)
         return 1
@@ -318,10 +336,46 @@ def _cmd_run(args: argparse.Namespace) -> int:
               "--auto-geometry (both are full-gang collectives)",
               file=sys.stderr)
         return 1
+    if args.elastic and (
+        args.survive_peer_loss or args.exchange_transport == "file"
+    ):
+        print("--elastic is incompatible with --survive-peer-loss and "
+              "--exchange-transport file (elastic membership has no "
+              "lockstep exchanges for the transport to carry)",
+              file=sys.stderr)
+        return 1
+    if args.survive_peer_loss and args.exchange_transport == "kv":
+        print("--survive-peer-loss requires the file-lease exchange "
+              "transport (the kv transport rides the jax coordination "
+              "service, which force-terminates survivors ~90-100s after a "
+              "peer death); pass --exchange-transport file or auto",
+              file=sys.stderr)
+        return 1
     for name, val in (("--exchange-deadline-s", args.exchange_deadline_s),
                       ("--lease-ttl-s", args.lease_ttl_s)):
         if val is not None and val <= 0:
             print(f"{name} must be positive, got {val}", file=sys.stderr)
+            return 1
+    if args.coordinator:
+        # Parse-time sanity for the deadline/TTL pair (effective values,
+        # library defaults filled in): a deadline at or under the TTL
+        # turns every slow lease renewal into a diagnosed "death".
+        from .resilience.membership import (
+            DEFAULT_EXCHANGE_DEADLINE_S,
+            DEFAULT_LEASE_TTL_S,
+        )
+
+        eff_deadline = (args.exchange_deadline_s
+                        if args.exchange_deadline_s is not None
+                        else DEFAULT_EXCHANGE_DEADLINE_S)
+        eff_ttl = (args.lease_ttl_s if args.lease_ttl_s is not None
+                   else DEFAULT_LEASE_TTL_S)
+        if eff_deadline <= eff_ttl:
+            print(f"--exchange-deadline-s ({eff_deadline:g}s) must exceed "
+                  f"--lease-ttl-s ({eff_ttl:g}s): with the exchange "
+                  "deadline at or under the lease TTL, every slow lease "
+                  "renewal is misclassified as a peer death",
+                  file=sys.stderr)
             return 1
     # --warmup on/off overrides the backend-default policy everywhere; the
     # env form reaches paths that build their pipeline deep inside the
@@ -352,6 +406,10 @@ def _cmd_run(args: argparse.Namespace) -> int:
                 mh_kwargs["lease_ttl_s"] = args.lease_ttl_s
             if args.elastic:
                 mh_kwargs["elastic"] = True
+            if args.exchange_transport != "auto":
+                mh_kwargs["exchange_transport"] = args.exchange_transport
+            if args.survive_peer_loss:
+                mh_kwargs["survive_peer_loss"] = True
             result = run_multihost(
                 config,
                 args.input_file,
@@ -419,8 +477,33 @@ def _cmd_run(args: argparse.Namespace) -> int:
         # mid-exit.  Flush the diagnosis and hard-exit deterministically —
         # there is no graceful path out of a broken gang.
         print(f"Pipeline run failed: {e}", file=sys.stderr, flush=True)
+        if args.run_report:
+            # Post-mortems of unreformable gangs shouldn't be blind: commit
+            # a partial, schema-tagged report naming the failed exchange
+            # before the hard exit.  Best-effort — the abort path must
+            # never mask the diagnosis above.
+            try:
+                from .utils.metrics import build_run_report, write_run_report
+
+                report = build_run_report(
+                    baseline=report_baseline,
+                    wall_time_s=time.perf_counter() - start,
+                    counts={},
+                    provenance=provenance,
+                )
+                report["aborted"] = True
+                report["peer_failure"] = {
+                    "message": str(e),
+                    "missing_ranks": list(e.missing_ranks),
+                    "dead_ranks": list(e.dead_ranks),
+                    "seq": e.seq,
+                    "epoch": e.epoch,
+                }
+                write_run_report(args.run_report, report)
+            except Exception:
+                pass
         profile_ctx.__exit__(None, None, None)
-        TRACER.close()
+        TRACER.close()  # flushes the trace spill to disk
         sys.stdout.flush()
         os._exit(1)
     except PipelineError as e:
@@ -465,10 +548,24 @@ def _cmd_run(args: argparse.Namespace) -> int:
             f"{neg_degraded} rounds degraded to the host oracle.",
             file=sys.stderr,
         )
+    reformations = int(METRICS.get("multihost_gang_reformations_total"))
+    if reformations:
+        # A reformed gang finished the run without the member(s) it started
+        # with — operationally loud even though the outputs are intact.
+        print(
+            f"Gang reformation: survived {reformations} peer-loss "
+            f"event(s); "
+            f"{int(METRICS.get('multihost_fenced_ranks_total'))} rank "
+            "incarnation(s) fenced, "
+            f"{int(METRICS.get('multihost_adopted_stripes_total'))} "
+            "stripe(s) adopted; final membership epoch "
+            f"{int(METRICS.get('multihost_membership_epoch'))}.",
+            file=sys.stderr,
+        )
     evictions = int(METRICS.get("multihost_evictions_total"))
     rejoins = int(METRICS.get("multihost_rejoins_total"))
     adopted = int(METRICS.get("multihost_adopted_stripes_total"))
-    if evictions or rejoins or adopted:
+    if (evictions or rejoins or adopted) and not reformations:
         # Membership churn is an operational signal like a degraded round:
         # the run completed, but not with the gang it started with.
         print(

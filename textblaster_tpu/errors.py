@@ -28,6 +28,8 @@ __all__ = [
     "CheckpointError",
     "RetryExhaustedError",
     "PeerFailure",
+    "GangReformed",
+    "ReformationFailed",
 ]
 
 
@@ -150,6 +152,55 @@ class PeerFailure(PipelineError):
 
     def __str__(self) -> str:
         return f"Peer failure: {self.args[0] if self.args else ''}"
+
+
+class GangReformed(PipelineError):
+    """The gang reformed around dead peer(s); the interrupted exchange must
+    be replayed over the survivor set (no reference equivalent).
+
+    Raised by the file-lease exchange transport under ``--survive-peer-loss``
+    *after* a successful reformation: the dead ranks' incarnations are
+    fenced, the survivor set is elected, and both the membership and
+    exchange epochs are bumped.  This is a control-flow signal, not a
+    terminal failure — callers catch it at a round/phase boundary, trim to
+    the resolved prefix, and re-enter the lockstep loop over ``members``.
+    """
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        members=(),
+        dead_ranks=(),
+        epoch: Optional[int] = None,
+    ) -> None:
+        super().__init__(message)
+        self.members = tuple(members)
+        self.dead_ranks = tuple(dead_ranks)
+        self.epoch = epoch
+
+    def __str__(self) -> str:
+        return f"Gang reformed: {self.args[0] if self.args else ''}"
+
+
+class ReformationFailed(PipelineError):
+    """The gang could not reform after a peer loss (no reference
+    equivalent).
+
+    Terminal, unlike :class:`GangReformed`: raised when the election never
+    converges within its attempt budget, when this process finds its own
+    incarnation fenced (it was suspected dead by a peer — continuing would
+    risk split-brain), or when the last survivor fails its own liveness
+    self-check (lease lost or heartbeat dead) so there is no gang left to
+    reform.  Survivors exit typed instead of hanging on a dead exchange.
+    """
+
+    def __init__(self, message: str, *, rank: Optional[int] = None) -> None:
+        super().__init__(message)
+        self.rank = rank
+
+    def __str__(self) -> str:
+        return f"Gang reformation failed: {self.args[0] if self.args else ''}"
 
 
 class RetryExhaustedError(PipelineError):

@@ -81,13 +81,16 @@ import numpy as np
 
 from ..config.pipeline import PipelineConfig
 from ..data_model import ProcessingOutcome, TextDocument
-from ..errors import PeerFailure
+from ..errors import GangReformed, PeerFailure, ReformationFailed
 from ..resilience.membership import (
     DEFAULT_EXCHANGE_DEADLINE_S,
     DEFAULT_LEASE_TTL_S,
+    EpochTracker,
+    FileMembershipStore,
     KVLeaseStore,
     LeaseHeartbeat,
     _kv_set,
+    elect_members,
 )
 from ..utils.trace import TRACER
 from .mesh import DATA_AXIS, batch_sharding
@@ -99,7 +102,13 @@ __all__ = [
     "configure_exchange",
     "bump_exchange_epoch",
     "current_exchange_epoch",
+    "ExchangeTransport",
+    "KVExchangeTransport",
+    "FileLeaseTransport",
+    "resolve_exchange_transport",
     "PeerFailure",
+    "GangReformed",
+    "ReformationFailed",
     "detect_stale_shards",
     "merge_shard_files",
     "run_local_shard",
@@ -295,10 +304,15 @@ class _ExchangeState:
         self.deadline_s: float = DEFAULT_EXCHANGE_DEADLINE_S
         self.epoch: int = 0
         self.seq: int = 0
-        self.lease_store: Optional[KVLeaseStore] = None
+        self.lease_store = None  # KVLeaseStore or FileMembershipStore
         # Own (epoch, seq) keys whose epoch drained but whose read-proof
         # (a peer completing a later exchange) hadn't landed yet.
         self.pending_delete: List[Tuple[int, int]] = []
+        # Active transport override: ``None`` means the default XLA/KV
+        # funnel (:class:`KVExchangeTransport`); :func:`run_multihost`
+        # installs a :class:`FileLeaseTransport` for ``--exchange-transport
+        # file`` runs.
+        self.transport: Optional["ExchangeTransport"] = None
 
 
 _EXCHANGE = _ExchangeState()
@@ -311,16 +325,19 @@ _PROBE_TIMEOUT_MS = 1000
 
 def configure_exchange(
     deadline_s: Optional[float] = None,
-    lease_store: Optional[KVLeaseStore] = None,
+    lease_store=None,
     reset: bool = True,
+    transport: Optional["ExchangeTransport"] = None,
 ) -> None:
-    """Configure the exchange deadline / lease table for this process and
-    (by default) restart the epoch/sequence counters — called by
-    :func:`run_multihost` on every process at run start, so the shared
-    round state begins aligned."""
+    """Configure the exchange deadline / lease table / transport for this
+    process and (by default) restart the epoch/sequence counters — called
+    by :func:`run_multihost` on every process at run start, so the shared
+    round state begins aligned.  ``transport=None`` selects the default
+    XLA/KV funnel (:class:`KVExchangeTransport`)."""
     if deadline_s is not None:
         _EXCHANGE.deadline_s = float(deadline_s)
     _EXCHANGE.lease_store = lease_store
+    _EXCHANGE.transport = transport
     if reset:
         _EXCHANGE.epoch = 0
         _EXCHANGE.seq = 0
@@ -425,20 +442,41 @@ def _raise_peer_failure(
     )
 
 
-def host_allgather(vec: np.ndarray) -> np.ndarray:
-    """Allgather one small int vector per process; returns ``[n_proc, len]``.
+class ExchangeTransport:
+    """Pluggable carrier for the lockstep exchanges (:func:`host_allgather`).
 
-    Every lockstep exchange in this module (round schedules, fault verdicts,
-    merged histograms, the totals barrier) funnels through here.  On
-    accelerator backends it is ``multihost_utils.process_allgather``; on a
-    multi-process CPU job — where XLA cannot run the collective at all — the
-    same exchange rides the ``jax.distributed`` coordination-service
-    key-value store, the transport that already carries barriers and
-    heartbeats.  Callers must invoke it in lockstep (the contract this
-    module enforces anyway): keys are ``(epoch, seq, rank)`` tuples from the
-    shared round state (:class:`_ExchangeState`), and the blocking gets
-    double as the barrier — no process proceeds until every peer has posted
-    its row.
+    Two implementations:
+
+    * :class:`KVExchangeTransport` (``kv``, the default) — the XLA
+      collective / ``jax.distributed`` coordination-service KV funnel,
+      byte-for-byte the pre-seam behavior.  Diagnoses a peer death fast
+      (typed :exc:`PeerFailure`) but cannot outlive it: the coordination
+      service force-terminates every healthy task ~90-100 s after a peer
+      stops heartbeating, regardless of what the survivor does.
+    * :class:`FileLeaseTransport` (``file``) — exchange slots on the shared
+      filesystem next to :class:`FileMembershipStore`'s liveness leases.
+      The gang is not coupled through ``jax.distributed`` at all, so under
+      ``--survive-peer-loss`` a peer death triggers gang *reformation*
+      (fence → elect → adopt) instead of gang death.
+    """
+
+    name: str = "?"
+
+    def members(self) -> Tuple[int, ...]:
+        """Current member ranks, in exchange row order."""
+        raise NotImplementedError
+
+    def allgather(self, arr: np.ndarray) -> np.ndarray:
+        """Exchange one flat int64 row per member; returns
+        ``[n_members, len(arr)]`` in :meth:`members` order."""
+        raise NotImplementedError
+
+
+class KVExchangeTransport(ExchangeTransport):
+    """The default transport: XLA collective on accelerator backends, the
+    ``jax.distributed`` coordination-service key-value store on multi-process
+    CPU jobs (where XLA cannot run the collective at all) — the transport
+    that already carries barriers and heartbeats.
 
     KV-path failure semantics (the exchange *deadline*, PR 6): the whole
     exchange gets ``configure_exchange``'s budget (default
@@ -457,65 +495,334 @@ def host_allgather(vec: np.ndarray) -> np.ndarray:
     this process's ``s-1`` key — and any queued keys from drained epochs —
     are deleted after each completed exchange.  The KV table stays O(1) per
     rank for the life of the coordinator."""
-    arr = np.asarray(vec, dtype=np.int64).ravel()
-    n = jax.process_count()
-    if n == 1:
-        return arr.reshape(1, -1)
-    if jax.default_backend() != "cpu":
-        from jax.experimental import multihost_utils
 
-        return np.asarray(
-            multihost_utils.process_allgather(arr), dtype=np.int64
-        ).reshape(n, -1)
-    from jax._src import distributed
+    name = "kv"
 
-    client = distributed.global_state.client
-    me = jax.process_index()
-    epoch, seq = _EXCHANGE.epoch, _EXCHANGE.seq
-    _EXCHANGE.seq += 1
-    _kv_set(
-        client,
-        _ag_key(epoch, seq, me),
-        ",".join(str(int(x)) for x in arr),
-    )
-    deadline_s = _EXCHANGE.deadline_s
-    t0 = time.monotonic()
-    own_row = [int(x) for x in arr]
-    rows: List[List[int]] = []
-    missing: List[int] = []
-    transport_error = ""
-    for r in range(n):
-        if r == me:
-            rows.append(own_row)
-            continue
-        remaining_ms = int((deadline_s - (time.monotonic() - t0)) * 1000)
-        timeout_ms = remaining_ms if remaining_ms > 0 else _PROBE_TIMEOUT_MS
-        try:
-            raw = client.blocking_key_value_get(
-                _ag_key(epoch, seq, r), timeout_ms
-            )
-        except Exception as e:  # DEADLINE_EXCEEDED / service teardown
-            missing.append(r)
-            rows.append([])
-            transport_error = str(e)
-            continue
-        rows.append([int(x) for x in raw.split(",")] if raw else [])
-    if missing:
-        _raise_peer_failure(
-            missing, seq=seq, epoch=epoch, deadline_s=deadline_s,
-            transport_error=transport_error,
+    def members(self) -> Tuple[int, ...]:
+        return tuple(range(jax.process_count()))
+
+    def allgather(self, arr: np.ndarray) -> np.ndarray:
+        n = jax.process_count()
+        if n == 1:
+            return arr.reshape(1, -1)
+        if jax.default_backend() != "cpu":
+            from jax.experimental import multihost_utils
+
+            return np.asarray(
+                multihost_utils.process_allgather(arr), dtype=np.int64
+            ).reshape(n, -1)
+        from jax._src import distributed
+
+        client = distributed.global_state.client
+        me = jax.process_index()
+        epoch, seq = _EXCHANGE.epoch, _EXCHANGE.seq
+        _EXCHANGE.seq += 1
+        _kv_set(
+            client,
+            _ag_key(epoch, seq, me),
+            ",".join(str(int(x)) for x in arr),
         )
-    _validate_rows(rows, len(own_row), seq=seq, epoch=epoch)
-    drained = [_ag_key(e, s, me) for e, s in _EXCHANGE.pending_delete]
-    _EXCHANGE.pending_delete.clear()
-    if seq > 0:
-        drained.append(_ag_key(epoch, seq - 1, me))
-    for key in drained:
+        deadline_s = _EXCHANGE.deadline_s
+        t0 = time.monotonic()
+        own_row = [int(x) for x in arr]
+        rows: List[List[int]] = []
+        missing: List[int] = []
+        transport_error = ""
+        for r in range(n):
+            if r == me:
+                rows.append(own_row)
+                continue
+            remaining_ms = int((deadline_s - (time.monotonic() - t0)) * 1000)
+            timeout_ms = (
+                remaining_ms if remaining_ms > 0 else _PROBE_TIMEOUT_MS
+            )
+            try:
+                raw = client.blocking_key_value_get(
+                    _ag_key(epoch, seq, r), timeout_ms
+                )
+            except Exception as e:  # DEADLINE_EXCEEDED / service teardown
+                missing.append(r)
+                rows.append([])
+                transport_error = str(e)
+                continue
+            rows.append([int(x) for x in raw.split(",")] if raw else [])
+        if missing:
+            _raise_peer_failure(
+                missing, seq=seq, epoch=epoch, deadline_s=deadline_s,
+                transport_error=transport_error,
+            )
+        _validate_rows(rows, len(own_row), seq=seq, epoch=epoch)
+        drained = [_ag_key(e, s, me) for e, s in _EXCHANGE.pending_delete]
+        _EXCHANGE.pending_delete.clear()
+        if seq > 0:
+            drained.append(_ag_key(epoch, seq - 1, me))
+        for key in drained:
+            try:
+                client.key_value_delete(key)
+            except Exception:  # pragma: no cover - hygiene is best-effort
+                pass
+        return np.asarray(rows, dtype=np.int64)
+
+
+_KV_TRANSPORT = KVExchangeTransport()
+
+
+class FileLeaseTransport(ExchangeTransport):
+    """File-lease exchange transport: slots on the shared filesystem.
+
+    Each exchange ``(epoch, seq)`` is a directory of per-rank slot files
+    under the membership root (``exchange/e{E}/s{S}/rank{r}.json``), posted
+    with the same atomic tmp+rename discipline as the liveness leases and
+    naming the poster's incarnation so a fenced zombie's late post is
+    ignored.  Reads are deadline-bounded polls over the member set; hygiene
+    mirrors the KV rules — completing exchange ``s`` proves every member
+    read ``s-1``, so the own ``s-1`` slot and any queued drained-epoch
+    slots are deleted after each completed exchange.
+
+    With ``survive=True``, a deadline expiry runs the reformation protocol
+    (fence the missing ranks' incarnations, elect the survivor set via
+    shared-filesystem proposals, bump the membership and exchange epochs)
+    and raises :exc:`GangReformed` for the driver to replay the interrupted
+    exchange over the survivors; without it, the expiry raises the same
+    typed :exc:`PeerFailure` the KV transport does.
+
+    Unlike the KV transport this one never touches ``jax.distributed`` —
+    that is the point: the coordination service force-terminates healthy
+    tasks ~90-100 s after a peer death, so survivability requires a carrier
+    the dead rank cannot take down."""
+
+    name = "file"
+
+    def __init__(
+        self,
+        store: FileMembershipStore,
+        rank: int,
+        num_processes: int,
+        *,
+        survive: bool = False,
+        heartbeat: Optional[LeaseHeartbeat] = None,
+        poll_s: float = 0.02,
+    ) -> None:
+        self.store = store
+        self.rank = int(rank)
+        self._members: Tuple[int, ...] = tuple(range(int(num_processes)))
+        self.survive = bool(survive)
+        self.heartbeat = heartbeat
+        self.poll_s = float(poll_s)
+        self.dead_ranks: List[int] = []
+        self.reformations = 0
+        self.tracker = EpochTracker(rank)
+        self.tracker.observe(self._members)
+
+    def members(self) -> Tuple[int, ...]:
+        return self._members
+
+    def _self_check(self, epoch: int, seq: int) -> None:
+        """Zombie/solo guard, run at every exchange: a rank whose own
+        incarnation got fenced (a peer reformed without it), or whose lease
+        went stale (heartbeat dead, filesystem gone), must terminate typed —
+        on a shrunk gang there may be no peer left to notice, so hanging on
+        slots that can never fill is the alternative."""
+        if self.store.self_fenced():
+            raise ReformationFailed(
+                f"rank {self.rank} (incarnation {self.store.incarnation}) "
+                f"found itself fenced at exchange e{epoch}/s{seq}: a peer "
+                "reformed the gang without it",
+                rank=self.rank,
+            )
+        hb_dead = self.heartbeat is not None and self.heartbeat.failed
+        if not hb_dead and not self.store.my_lease_fresh():
+            # Stale-but-present lease of this very incarnation: a long
+            # GIL hold (an XLA compile) can starve the heartbeat thread
+            # past the TTL, and on wake the main thread may reach this
+            # check before the overdue renewal lands.  That is a
+            # scheduling artifact, not a death — nobody fenced us (checked
+            # above) — so renew in place.  Gone, or overwritten by a
+            # successor incarnation, stays fatal below; and if a peer
+            # fenced us in the same gap, the next exchange's fence check
+            # terminates this rank typed.
+            d = self.store.read_leases().get(self.rank)
+            if (
+                d is not None
+                and d.get("incarnation") == self.store.incarnation
+            ):
+                try:
+                    self.store.post()
+                except OSError:
+                    pass  # renewal refused: fall through to the fatal raise
+        if hb_dead or not self.store.my_lease_fresh():
+            raise ReformationFailed(
+                f"rank {self.rank} failed its liveness self-check at "
+                f"exchange e{epoch}/s{seq}: "
+                + (
+                    "the lease heartbeat died"
+                    if hb_dead
+                    else "its own lease file is stale or gone "
+                    f"(ttl {self.store.ttl_s:g}s)"
+                )
+                + " — no quorum can include this process",
+                rank=self.rank,
+            )
+
+    def allgather(self, arr: np.ndarray) -> np.ndarray:
+        epoch, seq = _EXCHANGE.epoch, _EXCHANGE.seq
+        _EXCHANGE.seq += 1
+        self._self_check(epoch, seq)
+        own_row = [int(x) for x in arr]
+        mem = self._members
+        if len(mem) == 1:
+            # Solo gang: nothing to exchange, but the self-check above still
+            # ran — a double-death (reform down to one member, then lose the
+            # filesystem lease) fails typed instead of hanging on peers that
+            # can never post.
+            return np.asarray([own_row], dtype=np.int64)
+        self.store.post_exchange_slot(
+            epoch, seq, ",".join(str(int(x)) for x in arr)
+        )
+        deadline_s = _EXCHANGE.deadline_s
+        t0 = time.monotonic()
+        got = {self.rank: own_row}
+        while len(got) < len(mem):
+            for r in mem:
+                if r in got:
+                    continue
+                slot = self.store.read_exchange_slot(epoch, seq, r)
+                if slot is None:
+                    continue
+                if self.store.is_fenced(
+                    int(slot.get("rank", r)), str(slot.get("incarnation", ""))
+                ):
+                    continue  # a fenced zombie's late post
+                raw = str(slot.get("data", ""))
+                got[r] = [int(x) for x in raw.split(",")] if raw else []
+            if len(got) == len(mem):
+                break
+            if time.monotonic() - t0 >= deadline_s:
+                missing = [r for r in mem if r not in got]
+                if self.survive:
+                    self._reform(missing, epoch, seq)  # raises GangReformed
+                _raise_peer_failure(
+                    missing, seq=seq, epoch=epoch, deadline_s=deadline_s,
+                    transport_error=(
+                        "file-lease exchange slot(s) never appeared"
+                    ),
+                )
+            self._self_check(epoch, seq)
+            time.sleep(self.poll_s)
+        rows = [got[r] for r in mem]
+        _validate_rows(rows, len(own_row), seq=seq, epoch=epoch)
+        for e, s in _EXCHANGE.pending_delete:
+            self.store.delete_exchange_slot(e, s)
+        _EXCHANGE.pending_delete.clear()
+        if seq > 0:
+            self.store.delete_exchange_slot(epoch, seq - 1)
+        return np.asarray(rows, dtype=np.int64)
+
+    def _reform(self, missing: Sequence[int], epoch: int, seq: int) -> None:
+        """The reformation protocol, run by every survivor blocked at the
+        same ``(epoch, seq)``: fence the missing ranks' incarnations, elect
+        the new member set through shared-filesystem proposals
+        (:func:`elect_members`), bump the membership epoch (eviction
+        accounting) and the exchange epoch (slot-namespace hygiene — the
+        failed exchange's own slot is queued for deletion by the bump), and
+        raise :exc:`GangReformed` so the driver replays the interrupted
+        exchange over the survivors."""
+        from ..utils.metrics import METRICS
+
+        dead: List[int] = []
         try:
-            client.key_value_delete(key)
-        except Exception:  # pragma: no cover - hygiene is best-effort
-            pass
-    return np.asarray(rows, dtype=np.int64)
+            dead, _slow = self.store.resolve_liveness(missing)
+        except Exception:  # pragma: no cover - lease table best-effort
+            dead = []
+        TRACER.instant(
+            "gang_reform_start",
+            {"epoch": epoch, "seq": seq, "missing": list(missing),
+             "dead": list(dead)},
+        )
+        members, newly_dead = elect_members(
+            self.store,
+            self._members,
+            missing,
+            tag=f"e{epoch}s{seq}",
+            deadline_s=_EXCHANGE.deadline_s,
+        )
+        self._members = members
+        self.dead_ranks.extend(
+            r for r in newly_dead if r not in self.dead_ranks
+        )
+        self.reformations += 1
+        self.tracker.observe(members)
+        new_exchange_epoch = bump_exchange_epoch()
+        METRICS.inc("multihost_gang_reformations_total")
+        METRICS.set("multihost_reformation_epoch", float(self.tracker.epoch))
+        TRACER.instant(
+            "gang_reformation",
+            {"membership_epoch": self.tracker.epoch,
+             "exchange_epoch": new_exchange_epoch,
+             "members": list(members), "dead": list(newly_dead)},
+        )
+        print(
+            f"reform[{self.rank}]: exchange e{epoch}/s{seq} deadline "
+            f"({_EXCHANGE.deadline_s:g}s) expired; fenced rank(s) "
+            f"{list(newly_dead)} (lease table marked {list(dead)} dead); "
+            f"reformed to members {list(members)} at membership epoch "
+            f"{self.tracker.epoch}",
+            flush=True,
+        )
+        raise GangReformed(
+            f"rank(s) {list(newly_dead)} fenced at exchange e{epoch}/s{seq};"
+            f" members now {list(members)} (membership epoch "
+            f"{self.tracker.epoch})",
+            members=members,
+            dead_ranks=newly_dead,
+            epoch=self.tracker.epoch,
+        )
+
+
+def resolve_exchange_transport(choice: str, survive_peer_loss: bool) -> str:
+    """Resolve ``--exchange-transport {auto,kv,file}`` to a concrete name.
+
+    ``auto`` picks ``file`` when ``--survive-peer-loss`` is set (reformation
+    needs a carrier that outlives the coordination service) and ``kv``
+    otherwise (lowest exchange latency; XLA collective on accelerators).
+    Explicit ``kv`` + survive is a contradiction and fails fast."""
+    from ..errors import PipelineError
+
+    c = str(choice or "auto").lower()
+    if c not in ("auto", "kv", "file"):
+        raise PipelineError(
+            f"exchange transport must be one of auto/kv/file, got {choice!r}"
+        )
+    if c == "auto":
+        c = "file" if survive_peer_loss else "kv"
+    if survive_peer_loss and c != "file":
+        raise PipelineError(
+            "--survive-peer-loss requires the file-lease exchange transport"
+            " (the kv transport rides the jax coordination service, which "
+            "force-terminates survivors ~90-100s after a peer death); pass "
+            "--exchange-transport file or auto"
+        )
+    return c
+
+
+def host_allgather(vec: np.ndarray) -> np.ndarray:
+    """Allgather one small int vector per process; returns ``[n_proc, len]``.
+
+    Every lockstep exchange in this module (round schedules, fault verdicts,
+    merged histograms, the totals barrier) funnels through here, and from
+    here through the configured :class:`ExchangeTransport` — the XLA/KV
+    funnel by default (:class:`KVExchangeTransport`, byte-for-byte the
+    pre-seam behavior), or :class:`FileLeaseTransport` when
+    :func:`run_multihost` installed one via :func:`configure_exchange`.
+    Callers must invoke it in lockstep (the contract this module enforces
+    anyway): slots are ``(epoch, seq, rank)`` tuples from the shared round
+    state (:class:`_ExchangeState`), and the blocking read doubles as the
+    barrier — no process proceeds until every member has posted its row."""
+    arr = np.asarray(vec, dtype=np.int64).ravel()
+    transport = _EXCHANGE.transport
+    if transport is None:
+        transport = _KV_TRANSPORT
+    return transport.allgather(arr)
 
 
 def host_allgather_obj(obj) -> list:
@@ -527,9 +834,13 @@ def host_allgather_obj(obj) -> list:
     padded vectors are exchanged and each row decoded back.  Two collectives
     per call — callers must invoke it in lockstep, like every other
     exchange here.  Sized for metrics snapshots (a few KiB), not bulk data:
-    each byte travels as an int64 lane."""
+    each byte travels as an int64 lane.  The row count follows the active
+    transport's member set, not ``jax.process_count()`` — on a reformed
+    file-transport gang only survivors contribute rows (a reformation
+    *between* the two collectives raises :exc:`GangReformed` from the
+    second, so callers replay the whole closure, never decode with stale
+    lengths)."""
     data = json.dumps(obj, sort_keys=True).encode("utf-8")
-    n = jax.process_count()
     lens = host_allgather(np.array([len(data)]))[:, 0]
     width = max(1, int(lens.max()))
     buf = np.zeros(width, dtype=np.int64)
@@ -540,7 +851,7 @@ def host_allgather_obj(obj) -> list:
         json.loads(
             bytes(rows[i, : int(lens[i])].astype(np.uint8)).decode("utf-8")
         )
-        for i in range(n)
+        for i in range(rows.shape[0])
     ]
 
 
@@ -764,9 +1075,15 @@ def run_local_shard(
         and overlap_cfg.enabled
         and os.environ.get("TEXTBLAST_NO_OVERLAP") != "1"
     )
-    depth = _negotiate_depth(
-        max(1, overlap_cfg.pipeline_depth) if overlapped else 1
-    )
+    local_depth = max(1, overlap_cfg.pipeline_depth) if overlapped else 1
+    while True:
+        try:
+            depth = _negotiate_depth(local_depth)
+            break
+        except GangReformed:
+            # The reformation already bumped the exchange epoch; just
+            # replay the negotiation over the survivor set.
+            continue
     # Pack off the critical path: the process-wide pool (shared with the
     # single-host packers) packs rounds ahead of the launch cursor and the
     # next phase's survivor chunks behind the resolve cursor.  Serial mode
@@ -810,193 +1127,269 @@ def run_local_shard(
         # lets KV exchange keys be namespaced deterministically instead of
         # by a process-local counter (see _ExchangeState).
         bump_exchange_epoch()
-        needed_local = np.array(
-            [math.ceil(len(current[b]) / local_for[b]) for b in buckets],
-            dtype=np.int32,
-        )
-        schedule = _negotiate_max(needed_local)
-        if phase == 0 and rounds is not None and int(schedule.sum()) > rounds:
-            raise ValueError(
-                f"shard needs {int(schedule.sum())} rounds "
-                f"(local {int(needed_local.sum())}), got {rounds}"
-            )
-
-        # The phase's launch plan, in the negotiated (bucket, round) order
-        # every host shares.  The negotiated count covers the local ceil by
-        # construction; a violation would silently strand a tail chunk once
-        # launches run ahead of resolves, so fail loudly instead.
-        plan: List[tuple] = []
-        for b, n_rounds in zip(buckets, schedule):
-            local_batch = local_for[b]
-            assert int(n_rounds) * local_batch >= len(current[b]), (
-                f"bucket {b}: negotiated {int(n_rounds)} round(s) of "
-                f"{local_batch} rows cannot cover {len(current[b])} local "
-                "documents — geometry round-up stranded a tail chunk"
-            )
-            for r in range(int(n_rounds)):
-                plan.append(
-                    (b, r, current[b][r * local_batch : (r + 1) * local_batch])
-                )
-
-        inherited = prepack_next  # this phase's pre-packed chunks
-        prepack_next = {}
-        packs: dict = {}  # plan index -> PackedBatch (or its future)
-
-        def ensure_packed(j):
-            """Keep rounds j..j+K packed (or packing) ahead of the launch
-            cursor; cross-phase pre-packed chunks are adopted as-is."""
-            for k in range(j, min(j + depth + 1, len(plan))):
-                if k in packs:
-                    continue
-                kb, kr, kchunk = plan[k]
-                pre = inherited.pop((kb, kr), None)
-                if pre is not None:
-                    packs[k] = pre
-                elif pool is not None:
-                    packs[k] = pool.submit(
-                        pipeline._timed_pack, kchunk,
-                        batch_size=local_for[kb], max_len=kb,
-                    )
-                else:
-                    packs[k] = pipeline._timed_pack(
-                        kchunk, batch_size=local_for[kb], max_len=kb
-                    )
-
         last = phase == n_phases - 1
         rewrites = (not last) and phase_rewrites(phase)
+        # State that must survive a gang reformation re-entry of this phase:
+        # resolved rounds' outcomes/survivors stand (outcomes, next_current,
+        # degraded only ever grow), and the pre-pack handoff for the NEXT
+        # phase keys on next_current chunk indexes, which are persistent.
         next_current: dict = {b: [] for b in buckets}
         next_over: List[TextDocument] = []
         prepack_done = {b: 0 for b in buckets}
-
-        def absorb(src_bucket, alive):
-            """Fold one resolved round's survivors into the next phase —
-            incrementally, in resolve order (== the old flat-list partition
-            order), so full next-phase chunks can pack while this phase
-            still has rounds in flight (the next ``_negotiate_max`` needs
-            only the final counts, exchanged after the drain as before)."""
-            if last:
-                return
-            if rewrites:
-                # Survivor content may have been rewritten (C4) — re-route
-                # by current length.  Growth past every bucket is
-                # impossible (rewrites only drop chars), but route
-                # defensively anyway.
-                for d in alive:
-                    for nb in buckets:
-                        if len(d.content) <= nb - PACK_MARGIN:
-                            next_current[nb].append(d)
-                            break
-                    else:
-                        next_over.append(d)
-            else:
-                next_current[src_bucket].extend(alive)
-            if pool is None:
-                return
-            for nb in buckets if rewrites else (src_bucket,):
-                lb = local_for[nb]
-                k = prepack_done[nb]
-                # A full chunk's document prefix is final once appended
-                # (later resolves only extend the list), so it can pack now.
-                while (k + 1) * lb <= len(next_current[nb]):
-                    prepack_next[(nb, k)] = pool.submit(
-                        pipeline._timed_pack,
-                        next_current[nb][k * lb : (k + 1) * lb],
-                        batch_size=lb, max_len=nb,
-                    )
-                    k += 1
-                prepack_done[nb] = k
-
-        window: deque = deque()
-
-        def drain_window():
-            """Joint fault verdict convened at the window front: discard
-            this host's launched-ahead results so every host's program
-            order after the verdict is the same ``[retry(r), r+1, ...]`` —
-            the younger rounds re-dispatch fresh at their own resolve."""
-            n = sum(1 for e in window if e["out"] is not None or e["fault"])
-            for e in window:
-                e["out"] = None
-                e["fault"] = False
-            if n:
-                METRICS.inc("multihost_window_replayed_rounds_total", n)
-            TRACER.instant(
-                "window_drained",
-                {"replayed": n, "pending": len(window), "phase": phase},
-            )
-
-        def resolve_front():
-            """Block for the OLDEST in-flight round and assemble it — under
-            the negotiated verdict protocol when the guard is on.  Strict
-            FIFO at every depth: the window moves waits, never sequence."""
-            entry = window.popleft()
-            TRACER.counter("lockstep_window", len(window))
-            local, ph, eb = entry["batch"], entry["phase"], entry["bucket"]
-            t0 = time.perf_counter()
+        inherited = prepack_next  # this phase's pre-packed chunks
+        prepack_next = {}
+        reformed = False
+        while True:
+            plan: Optional[List[tuple]] = None
+            consumed: List[bool] = []
             try:
-                with TRACER.span(
-                    "lockstep_resolve", {"bucket": eb, "phase": ph}
+                if reformed:
+                    # Survivor re-entry: re-negotiate the window depth over
+                    # the reformed gang (a member with a different local
+                    # depth may have died).  Fault-free runs never take this
+                    # branch, so the exchange sequence they emit is
+                    # unchanged; the reformation itself already bumped the
+                    # exchange epoch, so no re-bump here.
+                    depth = _negotiate_depth(local_depth)
+                    reformed = False
+                needed_local = np.array(
+                    [
+                        math.ceil(len(current[b]) / local_for[b])
+                        for b in buckets
+                    ],
+                    dtype=np.int32,
+                )
+                schedule = _negotiate_max(needed_local)
+                if (
+                    phase == 0
+                    and rounds is not None
+                    and int(schedule.sum()) > rounds
                 ):
-                    if guard is None:
-                        stats = _local_stats(entry["out"])
-                    else:
-                        stats = guard.run_round(
-                            eb,
-                            dispatch=lambda: pipeline.dispatch_lockstep(
-                                local, ph, sh2, sh1
-                            ),
-                            fetch=_local_stats,
-                            inflight=entry["out"],
-                            launch_fault=entry["fault"],
-                            on_fault=drain_window,
-                        )
-                        if stats is None:
-                            # Jointly degraded: every host routes this
-                            # round's chunk to the host oracle; none
-                            # re-enters the program.
-                            degraded.extend(local.docs)
-                            return
-                    po, alive = pipeline.assemble_phase(local, stats, ph)
-                    outcomes.extend(po)
-                    absorb(eb, alive)
-            finally:
-                METRICS.inc(
-                    "multihost_window_stall_seconds_total",
-                    time.perf_counter() - t0,
-                )
+                    raise ValueError(
+                        f"shard needs {int(schedule.sum())} rounds "
+                        f"(local {int(needed_local.sum())}), got {rounds}"
+                    )
 
-        for j, (b, r, chunk) in enumerate(plan):
-            if guard is not None and guard.bucket_degraded(b):
-                # Breaker latched on negotiated verdicts, so every host
-                # reaches the same conclusion at the same round and the
-                # dispatch is skipped jointly — lockstep preserved
-                # without touching the device.
-                METRICS.inc("resilience_negotiated_degraded_rounds_total")
-                TRACER.instant(
-                    "negotiated_bucket_latched",
-                    {"bucket": b, "round": r, "phase": phase},
-                )
-                packs.pop(j, None)
-                degraded.extend(chunk)
-                continue
-            ensure_packed(j)
-            with TRACER.span(
-                "lockstep_round",
-                {"bucket": b, "round": r, "phase": phase,
-                 "rows": len(chunk)},
-            ):
-                item = packs.pop(j)
-                local = item.result() if hasattr(item, "result") else item
-                record_occupancy(local)
-                out, fault = launch(local, phase)
-            window.append({
-                "batch": local, "bucket": b, "phase": phase,
-                "out": out, "fault": fault,
-            })
-            TRACER.counter("lockstep_window", len(window))
-            while len(window) > depth:
-                resolve_front()
-        while window:
-            resolve_front()
+                # The phase's launch plan, in the negotiated (bucket,
+                # round) order every host shares.  The negotiated count
+                # covers the local ceil by construction; a violation would
+                # silently strand a tail chunk once launches run ahead of
+                # resolves, so fail loudly instead.
+                plan = []
+                for b, n_rounds in zip(buckets, schedule):
+                    local_batch = local_for[b]
+                    assert int(n_rounds) * local_batch >= len(current[b]), (
+                        f"bucket {b}: negotiated {int(n_rounds)} round(s) "
+                        f"of {local_batch} rows cannot cover "
+                        f"{len(current[b])} local documents — geometry "
+                        "round-up stranded a tail chunk"
+                    )
+                    for r in range(int(n_rounds)):
+                        plan.append(
+                            (
+                                b,
+                                r,
+                                current[b][
+                                    r * local_batch : (r + 1) * local_batch
+                                ],
+                            )
+                        )
+                consumed = [False] * len(plan)
+                packs: dict = {}  # plan index -> PackedBatch (or future)
+
+                def ensure_packed(j, plan=plan, packs=packs):
+                    """Keep rounds j..j+K packed (or packing) ahead of the
+                    launch cursor; cross-phase pre-packed chunks are
+                    adopted as-is."""
+                    for k in range(j, min(j + depth + 1, len(plan))):
+                        if k in packs:
+                            continue
+                        kb, kr, kchunk = plan[k]
+                        pre = inherited.pop((kb, kr), None)
+                        if pre is not None:
+                            packs[k] = pre
+                        elif pool is not None:
+                            packs[k] = pool.submit(
+                                pipeline._timed_pack, kchunk,
+                                batch_size=local_for[kb], max_len=kb,
+                            )
+                        else:
+                            packs[k] = pipeline._timed_pack(
+                                kchunk, batch_size=local_for[kb], max_len=kb
+                            )
+
+                def absorb(src_bucket, alive):
+                    """Fold one resolved round's survivors into the next
+                    phase — incrementally, in resolve order (== the old
+                    flat-list partition order), so full next-phase chunks
+                    can pack while this phase still has rounds in flight
+                    (the next ``_negotiate_max`` needs only the final
+                    counts, exchanged after the drain as before)."""
+                    if last:
+                        return
+                    if rewrites:
+                        # Survivor content may have been rewritten (C4) —
+                        # re-route by current length.  Growth past every
+                        # bucket is impossible (rewrites only drop chars),
+                        # but route defensively anyway.
+                        for d in alive:
+                            for nb in buckets:
+                                if len(d.content) <= nb - PACK_MARGIN:
+                                    next_current[nb].append(d)
+                                    break
+                            else:
+                                next_over.append(d)
+                    else:
+                        next_current[src_bucket].extend(alive)
+                    if pool is None:
+                        return
+                    for nb in buckets if rewrites else (src_bucket,):
+                        lb = local_for[nb]
+                        k = prepack_done[nb]
+                        # A full chunk's document prefix is final once
+                        # appended (later resolves only extend the list),
+                        # so it can pack now.
+                        while (k + 1) * lb <= len(next_current[nb]):
+                            prepack_next[(nb, k)] = pool.submit(
+                                pipeline._timed_pack,
+                                next_current[nb][k * lb : (k + 1) * lb],
+                                batch_size=lb, max_len=nb,
+                            )
+                            k += 1
+                        prepack_done[nb] = k
+
+                window: deque = deque()
+
+                def drain_window():
+                    """Joint fault verdict convened at the window front:
+                    discard this host's launched-ahead results so every
+                    host's program order after the verdict is the same
+                    ``[retry(r), r+1, ...]`` — the younger rounds
+                    re-dispatch fresh at their own resolve."""
+                    n = sum(
+                        1 for e in window if e["out"] is not None or e["fault"]
+                    )
+                    for e in window:
+                        e["out"] = None
+                        e["fault"] = False
+                    if n:
+                        METRICS.inc(
+                            "multihost_window_replayed_rounds_total", n
+                        )
+                    TRACER.instant(
+                        "window_drained",
+                        {"replayed": n, "pending": len(window),
+                         "phase": phase},
+                    )
+
+                def resolve_front():
+                    """Block for the OLDEST in-flight round and assemble it
+                    — under the negotiated verdict protocol when the guard
+                    is on.  Strict FIFO at every depth: the window moves
+                    waits, never sequence."""
+                    entry = window.popleft()
+                    TRACER.counter("lockstep_window", len(window))
+                    local, ph, eb = (
+                        entry["batch"], entry["phase"], entry["bucket"]
+                    )
+                    t0 = time.perf_counter()
+                    try:
+                        with TRACER.span(
+                            "lockstep_resolve", {"bucket": eb, "phase": ph}
+                        ):
+                            if guard is None:
+                                stats = _local_stats(entry["out"])
+                            else:
+                                stats = guard.run_round(
+                                    eb,
+                                    dispatch=lambda: (
+                                        pipeline.dispatch_lockstep(
+                                            local, ph, sh2, sh1
+                                        )
+                                    ),
+                                    fetch=_local_stats,
+                                    inflight=entry["out"],
+                                    launch_fault=entry["fault"],
+                                    on_fault=drain_window,
+                                )
+                                if stats is None:
+                                    # Jointly degraded: every host routes
+                                    # this round's chunk to the host
+                                    # oracle; none re-enters the program.
+                                    degraded.extend(local.docs)
+                                    consumed[entry["plan_idx"]] = True
+                                    return
+                            po, alive = pipeline.assemble_phase(
+                                local, stats, ph
+                            )
+                            outcomes.extend(po)
+                            absorb(eb, alive)
+                            consumed[entry["plan_idx"]] = True
+                    finally:
+                        METRICS.inc(
+                            "multihost_window_stall_seconds_total",
+                            time.perf_counter() - t0,
+                        )
+
+                for j, (b, r, chunk) in enumerate(plan):
+                    if guard is not None and guard.bucket_degraded(b):
+                        # Breaker latched on negotiated verdicts, so every
+                        # host reaches the same conclusion at the same
+                        # round and the dispatch is skipped jointly —
+                        # lockstep preserved without touching the device.
+                        METRICS.inc(
+                            "resilience_negotiated_degraded_rounds_total"
+                        )
+                        TRACER.instant(
+                            "negotiated_bucket_latched",
+                            {"bucket": b, "round": r, "phase": phase},
+                        )
+                        packs.pop(j, None)
+                        degraded.extend(chunk)
+                        consumed[j] = True
+                        continue
+                    ensure_packed(j)
+                    with TRACER.span(
+                        "lockstep_round",
+                        {"bucket": b, "round": r, "phase": phase,
+                         "rows": len(chunk)},
+                    ):
+                        item = packs.pop(j)
+                        local = (
+                            item.result() if hasattr(item, "result") else item
+                        )
+                        record_occupancy(local)
+                        out, fault = launch(local, phase)
+                    window.append({
+                        "batch": local, "bucket": b, "phase": phase,
+                        "out": out, "fault": fault, "plan_idx": j,
+                    })
+                    TRACER.counter("lockstep_window", len(window))
+                    while len(window) > depth:
+                        resolve_front()
+                while window:
+                    resolve_front()
+                break
+            except GangReformed:
+                # Resume at the next round boundary over the survivor set:
+                # every resolved round stands (its outcomes and survivors
+                # are already folded), and the unconsumed plan chunks — in
+                # flight, launched ahead, or never launched — are stitched
+                # back into ``current`` in plan order, so the replayed plan
+                # re-chunks them at identical boundaries (consumed rounds
+                # form a plan-order prefix per bucket; breaker-latched
+                # skips route to the host oracle either way).
+                if plan is not None:
+                    for b in buckets:
+                        current[b] = []
+                    for j, (b, _r, chunk) in enumerate(plan):
+                        if not consumed[j]:
+                            current[b].extend(chunk)
+                # Pre-packs inherited from the previous phase key on the
+                # abandoned plan's round numbering — drop them and pack
+                # fresh (futures are pure; unused results are garbage).
+                inherited = {}
+                reformed = True
         if last:
             break
         fallback.extend(next_over)
@@ -1041,6 +1434,8 @@ def run_multihost(
     exchange_deadline_s: float = DEFAULT_EXCHANGE_DEADLINE_S,
     lease_ttl_s: float = DEFAULT_LEASE_TTL_S,
     elastic: bool = False,
+    exchange_transport: str = "auto",
+    survive_peer_loss: bool = False,
 ):
     """Production multi-host entry (``textblast run --coordinator ...``).
 
@@ -1094,6 +1489,26 @@ def run_multihost(
     replaying zero completed chunks, with outcomes byte-identical to a
     fault-free run.  Incompatible with ``run_report``/``auto_geometry``
     (both are defined in terms of full-gang collectives).
+
+    ``exchange_transport`` / ``survive_peer_loss`` (PR 10): with the
+    ``file`` transport (:class:`FileLeaseTransport`; ``auto`` resolves to
+    it iff ``survive_peer_loss``) the lockstep exchanges ride shared-
+    filesystem slots next to the membership leases instead of
+    ``jax.distributed`` — which is never initialized on this path, because
+    the coordination service force-terminates every healthy task ~90-100 s
+    after a peer death and would undercut survival from below.  Each
+    process then runs its full-width local-device mesh (exactly the
+    multi-process CPU fallback :func:`global_data_mesh` already takes; the
+    compiled programs are collective-free either way — on accelerator pods
+    this trades the cross-host XLA mesh for survivability).  Under
+    ``survive_peer_loss`` a peer death mid-exchange triggers gang
+    reformation instead of gang death: survivors fence the dead rank's
+    incarnation, elect the new member set at a bumped membership epoch,
+    replay the interrupted exchange, and the lowest live rank adopts the
+    dead rank's stripe through :meth:`CheckpointState.adopt` — the final
+    merged outputs stay byte-identical to a fault-free run.  Keeps the
+    lockstep contract (unlike ``elastic``) and therefore keeps
+    ``run_report``/``auto_geometry``.
     """
     import os
     from itertools import islice
@@ -1137,6 +1552,23 @@ def run_multihost(
             else:
                 METRICS.inc("multihost_stale_shards_removed_total")
 
+    transport_name = resolve_exchange_transport(
+        exchange_transport, survive_peer_loss
+    )
+    if elastic and (survive_peer_loss or transport_name == "file"):
+        raise PipelineError(
+            "--elastic is incompatible with --survive-peer-loss and "
+            "--exchange-transport file: elastic membership deliberately has "
+            "no lockstep exchanges for the transport to carry"
+        )
+    if transport_name == "file" and exchange_deadline_s <= lease_ttl_s:
+        raise PipelineError(
+            f"--exchange-deadline-s ({exchange_deadline_s:g}s) must exceed "
+            f"--lease-ttl-s ({lease_ttl_s:g}s): with the exchange deadline "
+            "at or under the lease TTL, every slow lease renewal is "
+            "misclassified as a peer death"
+        )
+
     if elastic:
         if run_report is not None or auto_geometry:
             raise PipelineError(
@@ -1162,43 +1594,102 @@ def run_multihost(
             force=force,
         )
 
-    initialize(coordinator, num_processes, process_id)
-    if jax.process_count() != num_processes:
-        # Without this, a topology mismatch (typically jax.distributed
-        # already initialized with different numbers) surfaces as a hang or
-        # a shape error deep inside the first allgather.
-        raise PipelineError(
-            f"--num-processes {num_processes} does not match the "
-            f"initialized distributed runtime "
-            f"(jax.process_count()={jax.process_count()}); all processes "
-            "must be launched with the same topology, and an existing "
-            "jax.distributed initialization cannot be re-shaped"
-        )
-    arm_from_env(process_id=process_id)
-    configure_exchange(deadline_s=exchange_deadline_s)
     heartbeat = None
-    if jax.process_count() > 1 and _distributed_initialized():
-        # Liveness leases ride the same coordination-service KV store the
-        # exchanges do, so an expired exchange deadline can tell the user
-        # WHICH missing ranks are dead (lease expired) vs merely slow.
-        from jax._src import distributed
+    file_transport = None
+    membership_store = None
+    membership_root = f"{output_file}.membership"
+    if transport_name == "file":
+        # The file transport deliberately does NOT initialize
+        # jax.distributed: the coordination service force-terminates every
+        # healthy task ~90-100 s after a peer stops heartbeating (measured
+        # on this stack — the motivation for _run_elastic's identical
+        # choice), which would undercut --survive-peer-loss from below.
+        # The gang is coupled only through the membership dir on the shared
+        # filesystem; jax.process_count() stays 1, so global_data_mesh()
+        # hands every process its full-width local mesh — exactly the
+        # multi-process CPU fallback, with collective-free programs.
+        import shutil
 
-        client = getattr(distributed.global_state, "client", None)
-        if client is not None:
-            store = KVLeaseStore(client, process_id, lease_ttl_s)
-            store.post()
-            heartbeat = LeaseHeartbeat(
-                store, max(0.05, lease_ttl_s / 3.0)
+        if force and os.path.isdir(membership_root):
+            shutil.rmtree(membership_root, ignore_errors=True)
+        membership_store = FileMembershipStore(
+            membership_root, process_id, lease_ttl_s
+        )
+        membership_store.register()
+        heartbeat = LeaseHeartbeat(
+            membership_store, max(0.05, lease_ttl_s / 3.0)
+        )
+        heartbeat.start()
+        file_transport = FileLeaseTransport(
+            membership_store,
+            process_id,
+            num_processes,
+            survive=survive_peer_loss,
+            heartbeat=heartbeat,
+        )
+        arm_from_env(process_id=process_id)
+        configure_exchange(
+            deadline_s=exchange_deadline_s,
+            lease_store=membership_store,
+            transport=file_transport,
+        )
+        print(
+            f"coordinated[{process_id}]: file-lease exchange transport at "
+            f"{membership_root} (survive_peer_loss={survive_peer_loss}, "
+            f"deadline {exchange_deadline_s:g}s, lease ttl {lease_ttl_s:g}s)",
+            flush=True,
+        )
+    else:
+        initialize(coordinator, num_processes, process_id)
+        if jax.process_count() != num_processes:
+            # Without this, a topology mismatch (typically jax.distributed
+            # already initialized with different numbers) surfaces as a
+            # hang or a shape error deep inside the first allgather.
+            raise PipelineError(
+                f"--num-processes {num_processes} does not match the "
+                f"initialized distributed runtime "
+                f"(jax.process_count()={jax.process_count()}); all "
+                "processes must be launched with the same topology, and an "
+                "existing jax.distributed initialization cannot be "
+                "re-shaped"
             )
-            heartbeat.start()
-            configure_exchange(
-                deadline_s=exchange_deadline_s,
-                lease_store=store,
-                reset=False,
-            )
+        arm_from_env(process_id=process_id)
+        configure_exchange(deadline_s=exchange_deadline_s)
+        if jax.process_count() > 1 and _distributed_initialized():
+            # Liveness leases ride the same coordination-service KV store
+            # the exchanges do, so an expired exchange deadline can tell
+            # the user WHICH missing ranks are dead (lease expired) vs
+            # merely slow.
+            from jax._src import distributed
+
+            client = getattr(distributed.global_state, "client", None)
+            if client is not None:
+                store = KVLeaseStore(client, process_id, lease_ttl_s)
+                store.post()
+                heartbeat = LeaseHeartbeat(
+                    store, max(0.05, lease_ttl_s / 3.0)
+                )
+                heartbeat.start()
+                configure_exchange(
+                    deadline_s=exchange_deadline_s,
+                    lease_store=store,
+                    reset=False,
+                )
+
+    def _ride_reformations(fn):
+        """Replay a lockstep closure until it completes without a gang
+        reformation (at most num_processes-1 replays — each reformation
+        permanently shrinks the member set).  On the kv transport
+        GangReformed is never raised, so this is a transparent wrapper."""
+        while True:
+            try:
+                return fn()
+            except GangReformed:
+                continue
+
     try:
         mesh = global_data_mesh()
-        _align_trace_clocks()
+        _ride_reformations(_align_trace_clocks)
 
         import time as _time
 
@@ -1256,7 +1747,41 @@ def run_multihost(
             )
 
             hist = length_histogram([len(d.content) for d in docs])
-            hist = host_allgather(hist).sum(axis=0)
+            folded_stripes: set = set()
+
+            def _merged_hist():
+                # Reformation during geometry negotiation: the adopter-to-
+                # be (lowest live rank) folds each newly-dead stripe's
+                # length histogram into its own before the replay, so the
+                # merged histogram — and the geometry derived from it — is
+                # identical to the fault-free gang's.
+                nonlocal hist
+                if file_transport is not None and file_transport.dead_ranks:
+                    if process_id == min(file_transport.members()):
+                        for r in sorted(set(file_transport.dead_ranks)):
+                            if r in folded_stripes:
+                                continue
+                            folded_stripes.add(r)
+                            skip_r = min(r * stride, n_rows)
+                            take_r = max(0, min(stride, n_rows - skip_r))
+                            lens = [
+                                len(d.content)
+                                for d in islice(
+                                    read_documents(
+                                        input_file,
+                                        text_column=text_column,
+                                        id_column=id_column,
+                                        batch_size=read_batch_size,
+                                        skip_rows=skip_r,
+                                    ),
+                                    take_r,
+                                )
+                                if not isinstance(d, PipelineError)
+                            ]
+                            hist = hist + length_histogram(lens)
+                return host_allgather(hist).sum(axis=0)
+
+            hist = _ride_reformations(_merged_hist)
             if hist.sum() > 0:
                 geometry = geometry_from_histogram(
                     hist, backend=jax.default_backend()
@@ -1288,6 +1813,33 @@ def run_multihost(
             if deadletter is not None:
                 deadletter.close()
         result.read_errors = read_errors
+
+        if file_transport is not None:
+            return _finish_file_coordinated(
+                config=config,
+                input_file=input_file,
+                output_file=output_file,
+                excluded_file=excluded_file,
+                errors_file=errors_file,
+                finals=finals,
+                text_column=text_column,
+                id_column=id_column,
+                read_batch_size=read_batch_size,
+                num_processes=num_processes,
+                process_id=process_id,
+                n_rows=n_rows,
+                stride=stride,
+                mesh=mesh,
+                pipeline=pipeline,
+                result=result,
+                file_transport=file_transport,
+                membership_store=membership_store,
+                membership_root=membership_root,
+                run_report=run_report,
+                provenance=provenance,
+                values_before=values_before,
+                wall_t0=wall_t0,
+            )
 
         totals = np.array(
             [result.received, result.success, result.filtered,
@@ -1386,6 +1938,297 @@ def run_multihost(
     finally:
         if heartbeat is not None:
             heartbeat.stop()
+
+
+def _finish_file_coordinated(
+    *,
+    config,
+    input_file: str,
+    output_file: str,
+    excluded_file: str,
+    errors_file: Optional[str],
+    finals: Sequence[str],
+    text_column: str,
+    id_column: str,
+    read_batch_size: int,
+    num_processes: int,
+    process_id: int,
+    n_rows: int,
+    stride: int,
+    mesh,
+    pipeline,
+    result,
+    file_transport: FileLeaseTransport,
+    membership_store: FileMembershipStore,
+    membership_root: str,
+    run_report: Optional[str],
+    provenance: Optional[dict],
+    values_before: dict,
+    wall_t0: float,
+):
+    """Completion protocol for the file-transport coordinated path: adopt
+    dead ranks' stripes, exchange totals/report over the (possibly
+    reformed) member set, and have the lowest live rank merge.
+
+    Adoption is a *deferred completion phase*, not mid-stream surgery: a
+    dead rank committed nothing durable (shard files are written only after
+    its ``run_local_shard`` returned), so the lowest live rank reproduces
+    the whole stripe — a collective pass in which the adopter feeds the
+    stripe's documents and every other member feeds zero documents, keeping
+    the negotiated lockstep schedule identical on all survivors.  The
+    adopter then writes ``<final>.shard{r}`` exactly as rank ``r`` would
+    have and commits a completed per-stripe cursor
+    (:meth:`CheckpointState.adopt` + ``complete=True``), so if the adopter
+    itself dies the NEXT adopter skips finished stripes instead of
+    repeating them.  Every decision that could diverge (is the stripe done?
+    which stripes are dead?) is exchanged, never inferred locally, and the
+    whole protocol rides the same GangReformed-replay loop as the run
+    itself — a second death during adoption reforms again and resumes.
+
+    The merge and run-report write move from rank 0 to ``min(members)``
+    (rank 0 may be the dead one); shard files for ALL of
+    ``range(num_processes)`` exist by then — survivors' own plus adopted
+    ones — so the merged outputs are byte-identical to a fault-free run."""
+    from itertools import islice
+
+    from ..checkpoint import (
+        CheckpointState,
+        _config_fingerprint,
+        _input_fingerprint,
+    )
+    from ..errors import PipelineError
+    from ..orchestration import (
+        AggregationResult,
+        aggregate_results_from_stream,
+        read_documents,
+    )
+    from ..resilience import DeadLetterSink
+    from ..utils.metrics import (
+        METRICS,
+        _SPECS,
+        build_run_report,
+        metrics_snapshot,
+        write_run_report,
+    )
+
+    fingerprint = _input_fingerprint(input_file)
+    config_hash = _config_fingerprint(config)
+    my_token = {
+        "rank": process_id,
+        "incarnation": membership_store.incarnation,
+    }
+    adopted_done: set = set()
+
+    def _adopt_stripe(r: int, adopter: int) -> None:
+        skip_r = min(r * stride, n_rows)
+        take_r = max(0, min(stride, n_rows - skip_r))
+        adopt_docs: List[TextDocument] = []
+        dl = None
+        st = None
+        adopt_read_errors = 0
+        if process_id == adopter:
+            METRICS.inc("multihost_adopted_stripes_total")
+            TRACER.instant(
+                "stripe_adopted",
+                {"stripe": r, "epoch": file_transport.tracker.epoch},
+            )
+            print(
+                f"reform[{process_id}]: adopting dead rank {r}'s stripe "
+                f"({take_r} row(s))",
+                flush=True,
+            )
+            st = CheckpointState.adopt(
+                membership_store.stripe_dir(r),
+                my_token,
+                input_fingerprint=fingerprint,
+                config_hash=config_hash,
+            )
+            dl = (
+                DeadLetterSink(f"{errors_file}.shard{r}")
+                if errors_file is not None
+                else None
+            )
+            for item in islice(
+                read_documents(
+                    input_file,
+                    text_column=text_column,
+                    id_column=id_column,
+                    batch_size=read_batch_size,
+                    skip_rows=skip_r,
+                ),
+                take_r,
+            ):
+                if isinstance(item, PipelineError):
+                    adopt_read_errors += 1
+                    if dl is not None:
+                        dl.record_read_error(item)
+                else:
+                    adopt_docs.append(item)
+        try:
+            # Collective: every member runs the pass (non-adopters with
+            # zero documents still negotiate/launch the identical padded
+            # schedule), so the lockstep contract holds during adoption.
+            outcomes_r = run_local_shard(
+                config, adopt_docs, buckets=pipeline.geometry.buckets,
+                mesh=mesh, pipeline=pipeline,
+            )
+            if process_id == adopter:
+                res_r = aggregate_results_from_stream(
+                    iter(outcomes_r),
+                    f"{output_file}.shard{r}",
+                    f"{excluded_file}.shard{r}",
+                    deadletter=dl,
+                )
+        finally:
+            if dl is not None:
+                dl.close()
+        if process_id == adopter:
+            st.rows_consumed = take_r
+            st.read_errors = adopt_read_errors
+            st.received = res_r.received
+            st.success = res_r.success
+            st.filtered = res_r.filtered
+            st.errors = res_r.errors
+            st.complete = True
+            st.save(membership_store.stripe_dir(r))
+
+    all_totals = None
+    host_reports = None
+    while True:
+        try:
+            members = file_transport.members()
+            pending = [
+                r
+                for r in sorted(set(file_transport.dead_ranks))
+                if r not in adopted_done
+            ]
+            if pending:
+                r = pending[0]
+                adopter = min(members)
+                done = 0
+                if process_id == adopter:
+                    st = CheckpointState.load(membership_store.stripe_dir(r))
+                    done = int(st is not None and bool(st.complete))
+                # Joint decision, not a local read: if the adopter saw a
+                # completed cursor the commit is durable — every member
+                # agrees to skip; otherwise every member joins the pass.
+                joint = int(
+                    host_allgather(np.array([done], dtype=np.int64)).max()
+                )
+                if joint:
+                    adopted_done.add(r)
+                else:
+                    _adopt_stripe(r, adopter)
+                continue
+
+            # Totals barrier over the (possibly reformed) member set; the
+            # current adoption leader folds every dead stripe's committed
+            # counts in — recomputed fresh from the cursors on every replay
+            # so the fold stays idempotent — and the global sums match a
+            # fault-free gang's.
+            totals = np.array(
+                [result.received, result.success, result.filtered,
+                 result.errors, result.read_errors],
+                dtype=np.int64,
+            )
+            if file_transport.dead_ranks and process_id == min(
+                file_transport.members()
+            ):
+                for r in sorted(set(file_transport.dead_ranks)):
+                    st = CheckpointState.load(membership_store.stripe_dir(r))
+                    if st is not None:
+                        totals += np.array(
+                            [st.received, st.success, st.filtered,
+                             st.errors, st.read_errors],
+                            dtype=np.int64,
+                        )
+            all_totals = host_allgather(totals).reshape(-1, 5)
+
+            if run_report is not None:
+                now = metrics_snapshot()
+                local_delta = {
+                    k: round(now.get(k, 0.0) - values_before.get(k, 0.0), 6)
+                    for k in set(now) | set(values_before)
+                    if now.get(k, 0.0) != values_before.get(k, 0.0)
+                }
+                host_reports = host_allgather_obj(
+                    {
+                        "process": process_id,
+                        "wall_time_s": round(
+                            time.perf_counter() - wall_t0, 3
+                        ),
+                        "counts": {
+                            "received": result.received,
+                            "success": result.success,
+                            "filtered": result.filtered,
+                            "errors": result.errors,
+                            "read_errors": result.read_errors,
+                        },
+                        "metrics": local_delta,
+                    }
+                )
+            break
+        except GangReformed:
+            continue
+
+    merger = min(file_transport.members())
+    if process_id != merger:
+        # Heartbeat first, withdraw second: a renewal landing after the
+        # withdraw would resurrect the lease file (and the membership dir
+        # after the merger's cleanup).  stop() is idempotent — the outer
+        # finally's call is then a no-op.
+        if file_transport.heartbeat is not None:
+            file_transport.heartbeat.stop()
+        membership_store.withdraw()
+        return result
+
+    merge_shard_files(
+        [
+            (final, [f"{final}.shard{i}" for i in range(num_processes)])
+            for final in finals
+        ]
+    )
+    g = all_totals.sum(axis=0)
+    merged = AggregationResult()
+    merged.received, merged.success, merged.filtered = (
+        int(g[0]), int(g[1]), int(g[2])
+    )
+    merged.errors, merged.read_errors = int(g[3]), int(g[4])
+    if host_reports is not None:
+        summed: dict = {}
+        for h in host_reports:
+            for k, v in h["metrics"].items():
+                # Counters sum across hosts; gauges merge by max (same
+                # rule as the kv-path report).
+                if _SPECS.get(k, ("counter",))[0] == "gauge":
+                    summed[k] = max(summed.get(k, v), v)
+                else:
+                    summed[k] = summed.get(k, 0.0) + v
+        report = build_run_report(
+            values=summed,
+            wall_time_s=max(h["wall_time_s"] for h in host_reports),
+            counts={
+                "received": merged.received,
+                "success": merged.success,
+                "filtered": merged.filtered,
+                "errors": merged.errors,
+                "read_errors": merged.read_errors,
+            },
+            provenance=provenance,
+            hosts=host_reports,
+        )
+        write_run_report(run_report, report)
+    if file_transport.heartbeat is not None:
+        file_transport.heartbeat.stop()
+    membership_store.withdraw()
+    import shutil
+
+    # The merger outlives every peer's withdraw (they returned before the
+    # merge's totals barrier released it), so removing the membership dir
+    # here cannot race a live lease — at worst a peer's stale exchange
+    # slots vanish with it, which is the point.
+    shutil.rmtree(membership_root, ignore_errors=True)
+    return merged
 
 
 def _abandon_distributed() -> None:
@@ -1806,6 +2649,21 @@ def _main(argv: Optional[Sequence[str]] = None) -> int:
         "relaunched ranks rejoin in place",
     )
     ap.add_argument(
+        "--exchange-transport", choices=("auto", "kv", "file"),
+        default="auto",
+        help="lockstep exchange carrier: kv = the XLA/coordination-service "
+        "funnel, file = shared-filesystem slots riding the membership "
+        "leases (required for --survive-peer-loss); auto picks file iff "
+        "--survive-peer-loss",
+    )
+    ap.add_argument(
+        "--survive-peer-loss", action="store_true",
+        help="gang reformation on the coordinated path: on a peer death "
+        "the survivors fence the dead rank's incarnation, re-elect the "
+        "member set, adopt its stripe, and finish the run (file exchange "
+        "transport only)",
+    )
+    ap.add_argument(
         "--pipeline-depth", type=int, default=None,
         help="in-flight lockstep round window for THIS host; the joint "
         "depth is the min over every host's value, allgathered once at "
@@ -1832,6 +2690,27 @@ def _main(argv: Optional[Sequence[str]] = None) -> int:
         "(pass on every process — the snapshot exchange is a collective)",
     )
     args = ap.parse_args(argv)
+
+    if args.exchange_deadline_s <= args.lease_ttl_s:
+        ap.error(
+            f"--exchange-deadline-s ({args.exchange_deadline_s:g}) must "
+            f"exceed --lease-ttl-s ({args.lease_ttl_s:g}): with the "
+            "exchange deadline at or under the lease TTL, every slow lease "
+            "renewal is misclassified as a peer death"
+        )
+    if args.survive_peer_loss and args.exchange_transport == "kv":
+        ap.error(
+            "--survive-peer-loss requires the file-lease exchange "
+            "transport; pass --exchange-transport file or auto"
+        )
+    if args.elastic and (
+        args.survive_peer_loss or args.exchange_transport == "file"
+    ):
+        ap.error(
+            "--elastic is incompatible with --survive-peer-loss / "
+            "--exchange-transport file: elastic membership has no lockstep "
+            "exchanges for the transport to carry"
+        )
 
     if args.metrics_port is not None:
         setup_prometheus_metrics(args.metrics_port + args.process_id)
@@ -1872,6 +2751,8 @@ def _main(argv: Optional[Sequence[str]] = None) -> int:
             exchange_deadline_s=args.exchange_deadline_s,
             lease_ttl_s=args.lease_ttl_s,
             elastic=args.elastic,
+            exchange_transport=args.exchange_transport,
+            survive_peer_loss=args.survive_peer_loss,
             provenance={
                 "entry": "textblaster_tpu.parallel.multihost",
                 "pipeline_config": args.pipeline_config,
@@ -1888,6 +2769,16 @@ def _main(argv: Optional[Sequence[str]] = None) -> int:
         f"process {args.process_id}: {result.received} outcomes "
         f"({result.success} kept, {result.filtered} excluded)"
     )
+    from ..utils.metrics import METRICS
+
+    reformations = int(METRICS.get("multihost_gang_reformations_total"))
+    if reformations:
+        print(
+            f"process {args.process_id}: survived {reformations} gang "
+            "reformation(s); "
+            f"{int(METRICS.get('multihost_adopted_stripes_total'))} "
+            "stripe(s) adopted"
+        )
     return 0
 
 

@@ -20,7 +20,14 @@ Sites planted in this build:
   armed fault makes this process's lease go stale, so peers evict it);
 * ``"multihost.rejoin"``  — per stripe-cursor claim/adoption
   (:meth:`textblaster_tpu.checkpoint.CheckpointState.adopt` on the
-  ``--elastic`` path).
+  ``--elastic`` path);
+* ``"multihost.exchange.post"`` — per exchange-slot post on the file-lease
+  transport (:meth:`FileMembershipStore.post_exchange_slot` — an armed
+  fault makes this rank's exchange row never appear, so peers hit the
+  deadline and, under ``--survive-peer-loss``, reform around it);
+* ``"multihost.reform"``  — per reformation election attempt
+  (:func:`textblaster_tpu.resilience.membership.elect_members`), so the
+  reformation protocol itself is chaos-testable.
 
 The injector is **inert by default**: with nothing armed, :meth:`fire` is a
 single attribute load + falsy check and keeps no per-call state, so

@@ -52,7 +52,7 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from ..errors import PeerFailure, PipelineError
+from ..errors import PeerFailure, PipelineError, ReformationFailed
 from ..utils.metrics import METRICS
 from ..utils.trace import TRACER
 from .faults import FAULTS
@@ -67,6 +67,7 @@ __all__ = [
     "LeaseHeartbeat",
     "EpochTracker",
     "stripe_owner",
+    "elect_members",
     "LEASE_PREFIX",
 ]
 
@@ -293,6 +294,176 @@ class FileMembershipStore:
         os.makedirs(path, exist_ok=True)
         return path
 
+    def resolve_liveness(
+        self, ranks: Sequence[int], now: Optional[float] = None
+    ) -> Tuple[List[int], List[int]]:
+        """Classify ``ranks`` into ``(dead, slow)`` against the lease files
+        (same contract as :meth:`KVLeaseStore.resolve_liveness`, so the
+        deadline path's failure report works on either backend)."""
+        now = time.time() if now is None else now
+        leases = self.read_leases()
+        dead, slow = [], []
+        for r in ranks:
+            d = leases.get(int(r))
+            if d is None or now - float(d.get("time", 0.0)) > self.ttl_s:
+                dead.append(int(r))
+            else:
+                slow.append(int(r))
+        return dead, slow
+
+    # --- exchange slots (FileLeaseTransport storage) -------------------------
+    #
+    # One file per (exchange epoch, sequence number, rank) under
+    # ``exchange/e{E}/s{S}/rank{r}.json`` — the file-backed twin of the KV
+    # transport's ``textblast/allgather/e{E}/s{S}/{r}`` keys.  Posts are
+    # atomic (tmp + ``os.replace``) and name the poster's incarnation so a
+    # fenced zombie's late post can be ignored by readers.
+
+    def exchange_slot_dir(self, epoch: int, seq: int) -> str:
+        return os.path.join(
+            self.root, "exchange", f"e{int(epoch)}", f"s{int(seq)}"
+        )
+
+    def post_exchange_slot(self, epoch: int, seq: int, payload: str) -> None:
+        FAULTS.fire("multihost.exchange.post")
+        d = self.exchange_slot_dir(epoch, seq)
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, f"rank{self.rank}.json")
+        tmp = f"{path}.tmp.{self.incarnation}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(
+                {
+                    "rank": self.rank,
+                    "incarnation": self.incarnation,
+                    "data": payload,
+                },
+                f,
+            )
+        os.replace(tmp, path)
+        METRICS.inc("multihost_file_exchange_posts_total")
+
+    def read_exchange_slot(
+        self, epoch: int, seq: int, rank: int
+    ) -> Optional[dict]:
+        path = os.path.join(
+            self.exchange_slot_dir(epoch, seq), f"rank{int(rank)}.json"
+        )
+        try:
+            with open(path, encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def delete_exchange_slot(self, epoch: int, seq: int) -> None:
+        """Drop this rank's slot at ``(epoch, seq)`` and opportunistically
+        remove the emptied seq/epoch dirs (the last deleter wins the
+        ``rmdir``; everyone else's fails harmlessly on non-empty)."""
+        d = self.exchange_slot_dir(epoch, seq)
+        try:
+            os.remove(os.path.join(d, f"rank{self.rank}.json"))
+        except OSError:
+            return
+        for p in (d, os.path.dirname(d)):
+            try:
+                os.rmdir(p)
+            except OSError:
+                break
+
+    # --- incarnation fencing -------------------------------------------------
+    #
+    # ``fence/rank{r}.{incarnation}.json`` marks one launch of rank ``r``
+    # as excluded from the gang.  Fence files are write-once (O_EXCL) and
+    # only ever added, so concurrent fencers converge without
+    # read-modify-write races; a fenced process discovers its own fence at
+    # its next exchange and terminates typed instead of splitting the brain.
+
+    def _fence_dir(self) -> str:
+        return os.path.join(self.root, "fence")
+
+    def fence_rank(self, rank: int) -> Tuple[str, bool]:
+        """Fence ``rank``'s current lease incarnation (``"any"`` when no
+        lease is readable — safe on the coordinated path, which never
+        relaunches ranks).  Returns ``(incarnation, newly_fenced)``."""
+        d = self.read_leases().get(int(rank))
+        inc = str(d["incarnation"]) if d and d.get("incarnation") else "any"
+        fdir = self._fence_dir()
+        os.makedirs(fdir, exist_ok=True)
+        path = os.path.join(fdir, f"rank{int(rank)}.{inc}.json")
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return inc, False
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            json.dump(
+                {
+                    "rank": int(rank),
+                    "incarnation": inc,
+                    "by": self.rank,
+                    "time": time.time(),
+                },
+                f,
+            )
+        METRICS.inc("multihost_fenced_ranks_total")
+        TRACER.instant(
+            "rank_fenced",
+            {"rank": int(rank), "incarnation": inc, "by": self.rank},
+        )
+        return inc, True
+
+    def fenced_ranks(self) -> List[int]:
+        """Sorted ranks with at least one fence file (any incarnation)."""
+        out = set()
+        try:
+            names = os.listdir(self._fence_dir())
+        except FileNotFoundError:
+            return []
+        for name in names:
+            if not (name.startswith("rank") and name.endswith(".json")):
+                continue
+            try:
+                out.add(int(name[4:].split(".", 1)[0]))
+            except ValueError:
+                continue
+        return sorted(out)
+
+    def is_fenced(self, rank: int, incarnation: str) -> bool:
+        fdir = self._fence_dir()
+        return os.path.exists(
+            os.path.join(fdir, f"rank{int(rank)}.{incarnation}.json")
+        ) or os.path.exists(os.path.join(fdir, f"rank{int(rank)}.any.json"))
+
+    def self_fenced(self) -> bool:
+        return self.is_fenced(self.rank, self.incarnation)
+
+    # --- reformation proposals ----------------------------------------------
+
+    def _proposal_dir(self, tag: str) -> str:
+        return os.path.join(self.root, "reform", tag)
+
+    def post_proposal(self, tag: str, members: Sequence[int]) -> None:
+        d = self._proposal_dir(tag)
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, f"rank{self.rank}.json")
+        tmp = f"{path}.tmp.{self.incarnation}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(
+                {
+                    "rank": self.rank,
+                    "incarnation": self.incarnation,
+                    "members": sorted(int(r) for r in members),
+                },
+                f,
+            )
+        os.replace(tmp, path)
+
+    def read_proposal(self, tag: str, rank: int) -> Optional[dict]:
+        path = os.path.join(self._proposal_dir(tag), f"rank{int(rank)}.json")
+        try:
+            with open(path, encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
 
 class LeaseHeartbeat:
     """Daemon thread renewing a lease store every ``interval_s``.
@@ -354,6 +525,101 @@ def stripe_owner(stripe: int, live: Sequence[int]) -> Optional[int]:
     if not live:
         return None
     return int(stripe) if int(stripe) in live else live[0]
+
+
+def elect_members(
+    store: FileMembershipStore,
+    members: Sequence[int],
+    suspects: Sequence[int],
+    tag: str,
+    deadline_s: float,
+    max_attempts: int = 8,
+    poll_s: float = 0.02,
+) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
+    """Deterministic survivor election for gang reformation.
+
+    Every survivor of a failed lockstep exchange is blocked at the *same*
+    ``(epoch, seq)`` (exchanges are blocking and lockstep), so ``tag`` —
+    derived from those coordinates — names a common rendezvous directory
+    with no extra negotiation.  The protocol is fence-then-elect:
+
+    1. Fence every suspect's incarnation.  Fencing makes exclusion safe
+       regardless of whether the suspect was dead or merely wedged — a
+       fenced zombie discovers the fence at its next exchange post and
+       terminates typed rather than splitting the brain.
+    2. Compute candidates = ``members`` minus all fenced ranks (the fence
+       table is shared and only ever grows, so survivors converge on it).
+    3. Post a proposal naming the candidate set; wait (deadline-bounded)
+       for a proposal from every candidate.
+    4. All proposals identical → elected.  A missing proposer joins the
+       suspects for the next attempt; a disagreeing proposal's exclusions
+       are adopted (union of everyone's suspicions) and the attempt
+       repeats against the merged fence table.
+
+    Returns ``(new_members, newly_dead)``.  Raises
+    :class:`~textblaster_tpu.errors.ReformationFailed` when this process
+    finds itself fenced or the election exhausts ``max_attempts``.
+
+    Mutual-suspicion caveat: if two partitions each fence the other (e.g.
+    a filesystem stall on both sides), *both* find themselves fenced and
+    terminate typed.  That sacrifices availability for safety — no member
+    set containing a fenced rank is ever elected.
+    """
+    me = store.rank
+    members = sorted({int(r) for r in members})
+    suspects = {int(r) for r in suspects} - {me}
+    for attempt in range(max_attempts):
+        FAULTS.fire("multihost.reform")
+        for r in sorted(suspects):
+            store.fence_rank(r)
+        if store.self_fenced():
+            raise ReformationFailed(
+                f"rank {me} (incarnation {store.incarnation}) was fenced by "
+                "a peer during reformation — terminating to avoid "
+                "split-brain",
+                rank=me,
+            )
+        fenced = set(store.fenced_ranks()) - {me}
+        candidates = [r for r in members if r not in fenced]
+        if not candidates or me not in candidates:
+            raise ReformationFailed(
+                f"rank {me} computed an empty/self-excluding candidate set "
+                f"{candidates} from members {members}",
+                rank=me,
+            )
+        attempt_tag = f"{tag}.a{attempt}"
+        store.post_proposal(attempt_tag, candidates)
+        deadline = time.monotonic() + float(deadline_s)
+        proposals: Dict[int, dict] = {}
+        while True:
+            for r in candidates:
+                if r not in proposals:
+                    p = store.read_proposal(attempt_tag, r)
+                    if p is not None:
+                        proposals[r] = p
+            if len(proposals) == len(candidates):
+                break
+            if time.monotonic() >= deadline:
+                break
+            time.sleep(poll_s)
+        missing = [r for r in candidates if r not in proposals]
+        if not missing and all(
+            p.get("members") == candidates for p in proposals.values()
+        ):
+            newly_dead = tuple(r for r in members if r not in candidates)
+            return tuple(candidates), newly_dead
+        # A candidate that never proposed is itself suspect now; a
+        # disagreeing candidate saw fences this process hasn't — adopt its
+        # exclusions and retry against the merged fence table.
+        suspects |= set(missing)
+        for p in proposals.values():
+            suspects |= set(members) - {int(r) for r in p.get("members", ())}
+        suspects -= {me}
+    raise ReformationFailed(
+        f"election did not converge after {max_attempts} attempts "
+        f"(members {members}, last suspects {sorted(suspects)})",
+        rank=me,
+    )
 
 
 class EpochTracker:

@@ -164,7 +164,19 @@ class NegotiatedGuard:
         degraded the round to the host oracle.  Fatal (deterministic)
         errors propagate immediately — they would repeat identically on
         every retry and on every host.
+
+        Gang reformation (``--survive-peer-loss`` on the file-lease
+        transport): the verdict exchange itself can discover a dead peer,
+        in which case the transport reforms the gang and raises
+        :exc:`GangReformed` *through* this method — deliberately uncaught
+        here, because a round verdict cannot be salvaged when the member
+        set changed mid-exchange.  The phase driver in ``run_local_shard``
+        catches it at the round boundary and replays every unresolved
+        round (this one included) over the survivor set; a trace instant
+        marks the interruption point.
         """
+        from ..errors import GangReformed
+
         METRICS.inc("resilience_negotiated_rounds_total")
         attempt = 0
         while True:
@@ -186,7 +198,16 @@ class NegotiatedGuard:
             # Past the first attempt nothing is in flight: a negotiated
             # retry must re-dispatch on EVERY host, succeeded ones included.
             inflight, launch_fault = None, False
-            if not self._negotiate(local_fault):
+            try:
+                any_fault = self._negotiate(local_fault)
+            except GangReformed:
+                TRACER.instant(
+                    "negotiated_reformed",
+                    {"bucket": bucket, "attempt": attempt,
+                     "epoch": self._epoch()},
+                )
+                raise
+            if not any_fault:
                 self.breakers[bucket].record_success()
                 return stats
             TRACER.instant(

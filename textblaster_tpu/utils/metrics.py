@@ -238,6 +238,27 @@ _SPECS: Dict[str, Tuple[str, str]] = {
         "Launched-ahead lockstep rounds discarded and re-dispatched after "
         "a negotiated fault verdict drained the window",
     ),
+    "multihost_gang_reformations_total": (
+        "counter",
+        "Gang reformations completed on the coordinated path "
+        "(--survive-peer-loss): dead rank fenced, survivor set elected, "
+        "interrupted exchange replayed",
+    ),
+    "multihost_fenced_ranks_total": (
+        "counter",
+        "Rank incarnations fenced during gang reformation (a fenced "
+        "incarnation's late exchange posts are ignored forever)",
+    ),
+    "multihost_reformation_epoch": (
+        "gauge",
+        "Membership epoch after the most recent gang reformation on the "
+        "coordinated path (gang-agreed; max-merged in the run report)",
+    ),
+    "multihost_file_exchange_posts_total": (
+        "counter",
+        "Exchange slot files posted by the file-lease transport "
+        "(--exchange-transport file), one per rank per collective",
+    ),
     # Overlapped-pipeline stage accounting (no reference equivalent).  The
     # counters are wall seconds spent *inside* each stage, summed across
     # worker threads; with overlap on, stages run concurrently, so the sum
