@@ -980,6 +980,95 @@ def main() -> int:
             fused_report = {"error": str(e)}
             _log(f"fused A/B skipped: {e}")
 
+    # --- Dependency-chain fusion on/off A/B (BENCH_DEPFUSE=0 skips).  A
+    # fresh pipeline traced under TEXTBLAST_DEPFUSE=off runs each filter's
+    # dependent scans as separate staged dispatches (the pre-chain layout);
+    # the default collapses each dependency chain — hash -> dedup tables,
+    # word cumsum -> n_words consumers, sentence DFA -> boundary counters —
+    # into one multi-pass chain_scan kernel whose intermediate streams stay
+    # in VMEM.  Decisions must stay byte-identical on vs off vs host oracle;
+    # dispatch counts are trace-level under interpret, as in the fused A/B.
+    depfuse_report = None
+    if os.environ.get("BENCH_DEPFUSE", "1") != "0":
+        from textblaster_tpu.ops.pallas_scan import depfuse_enabled
+
+        try:
+            prev_df = os.environ.get("TEXTBLAST_DEPFUSE")
+            os.environ["TEXTBLAST_DEPFUSE"] = "off"
+            try:
+                p_nd = CompiledPipeline(
+                    config,
+                    buckets=bench_buckets,
+                    batch_size=device_batch,
+                    geometry=geometry,
+                )
+                p_nd.warmup_parallel()
+                _kernel_pass(p_nd)  # untimed warm pass
+                nd_rate, nd_out = _kernel_pass(p_nd)
+            finally:
+                if prev_df is None:
+                    os.environ.pop("TEXTBLAST_DEPFUSE", None)
+                else:
+                    os.environ["TEXTBLAST_DEPFUSE"] = prev_df
+            d_rate, d_out = _kernel_pass(pipeline)
+            d_by_id = {o.document.id: o.kind for o in d_out}
+            nd_by_id = {o.document.id: o.kind for o in nd_out}
+            three_way = sum(
+                1
+                for k, v in host_by_id.items()
+                if d_by_id.get(k) == v and nd_by_id.get(k) == v
+            ) / max(len(host_by_id), 1)
+
+            dispatches = {}
+            tot_on = tot_off = 0
+            prev_int = os.environ.get("TEXTBLAST_PALLAS_INTERPRET")
+            os.environ["TEXTBLAST_PALLAS_INTERPRET"] = "1"
+            try:
+                for length in pipeline.geometry.buckets:
+                    for phase in range(len(pipeline.phases)):
+                        on_c = pipeline.scan_dispatch_counts(length, phase)
+                        prev2 = os.environ.get("TEXTBLAST_DEPFUSE")
+                        os.environ["TEXTBLAST_DEPFUSE"] = "off"
+                        try:
+                            off_c = pipeline.scan_dispatch_counts(
+                                length, phase
+                            )
+                        finally:
+                            if prev2 is None:
+                                os.environ.pop("TEXTBLAST_DEPFUSE", None)
+                            else:
+                                os.environ["TEXTBLAST_DEPFUSE"] = prev2
+                        tot_on += sum(on_c.values())
+                        tot_off += sum(off_c.values())
+                        dispatches[f"{length}/p{phase}"] = {
+                            "depfuse": on_c,
+                            "staged": off_c,
+                        }
+            finally:
+                if prev_int is None:
+                    os.environ.pop("TEXTBLAST_PALLAS_INTERPRET", None)
+                else:
+                    os.environ["TEXTBLAST_PALLAS_INTERPRET"] = prev_int
+            depfuse_report = {
+                "depfuse_enabled": depfuse_enabled(),
+                "on_docs_per_sec": round(d_rate, 2),
+                "off_docs_per_sec": round(nd_rate, 2),
+                "speedup": round(d_rate / nd_rate, 4),
+                "parity_on_off_host": round(three_way, 6),
+                "scan_dispatches_on": tot_on,
+                "scan_dispatches_off": tot_off,
+                "scan_dispatches": dispatches,
+            }
+            _log(
+                f"depfuse A/B: {d_rate:.1f} docs/s on vs {nd_rate:.1f} off "
+                f"(x{depfuse_report['speedup']}, dispatches {tot_on} vs "
+                f"{tot_off}, 3-way parity {three_way:.4f})"
+            )
+            del p_nd
+        except Exception as e:  # never bill a kernel A/B problem to the bench
+            depfuse_report = {"error": str(e)}
+            _log(f"depfuse A/B skipped: {e}")
+
     # --- Negotiated fault-guard overhead, fault-free (BENCH_RESILIENCE=0
     # skips).  The multi-host lockstep rounds run under the negotiated guard
     # by default (resilience/negotiated.py); its only per-round addition is
@@ -1211,6 +1300,25 @@ pipeline:
                         "overlapped": round(ov_s, 3),
                         "serial": round(se_s, 3),
                     },
+                    # Total allgather posts per arm (max over hosts; both
+                    # hosts post in lockstep, so the rows agree).  The
+                    # batched verdict exchange drains a K-deep window's
+                    # fault flags in ONE vector post, so the overlapped arm
+                    # must come in below serial's one-post-per-round.
+                    "exchange_posts": {
+                        "overlapped": int(max(
+                            (h["metrics"].get(
+                                "multihost_exchange_posts_total", 0)
+                             for h in ov_rep.get("hosts", [])),
+                            default=0,
+                        )),
+                        "serial": int(max(
+                            (h["metrics"].get(
+                                "multihost_exchange_posts_total", 0)
+                             for h in se_rep.get("hosts", [])),
+                            default=0,
+                        )),
+                    },
                     "n_docs": len(mh_docs),
                     "processes": 2,
                 }
@@ -1274,6 +1382,24 @@ pipeline:
                     "file_reformations": int(
                         fl_res.get("multihost_gang_reformations_total", 0)
                     ),
+                    # Allgather posts per arm (max over hosts) — on the
+                    # file transport every post is a slot file + poll, so
+                    # the batched verdict exchange's saved posts are saved
+                    # filesystem round-trips here.
+                    "exchange_posts": {
+                        "kv": int(max(
+                            (h["metrics"].get(
+                                "multihost_exchange_posts_total", 0)
+                             for h in kv_rep.get("hosts", [])),
+                            default=0,
+                        )),
+                        "file": int(max(
+                            (h["metrics"].get(
+                                "multihost_exchange_posts_total", 0)
+                             for h in fl_rep.get("hosts", [])),
+                            default=0,
+                        )),
+                    },
                     "n_docs": len(rf_docs),
                     "processes": 2,
                 }
@@ -1409,6 +1535,10 @@ pipeline:
         # per-(bucket, phase) scan dispatch counts (trace-level, counted
         # under interpret so the structural reduction shows on any backend).
         **({"fused": fused_report} if fused_report else {}),
+        # Dependency-chain fusion on/off A/B: docs/s, three-way parity
+        # gate, and per-(bucket, phase) dispatch counts with the multi-pass
+        # chains on (TEXTBLAST_DEPFUSE default) vs staged (off).
+        **({"depfuse": depfuse_report} if depfuse_report else {}),
         # Per-stage wall seconds across the 3 timed passes + the host-bound
         # vs device-bound verdict (stages overlap, so the sum can exceed
         # wall time; compare stages to each other).

@@ -14,6 +14,7 @@ XLA lowers to log-depth work-efficient trees on the VPU.
 from __future__ import annotations
 
 from functools import lru_cache
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp
@@ -45,6 +46,7 @@ __all__ = [
     "MID_LETTER_CPS",
     "MID_NUM_CPS",
     "MID_ALL_CPS",
+    "word_base",
     "word_mask",
     "HASH_MUL",
 ]
@@ -394,10 +396,12 @@ def rev(x: jax.Array, axis: int = 1) -> jax.Array:
     return jnp.flip(x, axis=axis)
 
 
-def word_mask(cps: jax.Array, cls: jax.Array) -> jax.Array:
-    """In-word mask — the device twin of ``utils.text._word_mask``.
+def word_base(cps: jax.Array, cls: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Raw pre-WB4 wordness plus the Extend mask — the elementwise half of
+    :func:`word_mask`, exposed so the dependency-fused chain kernel can run
+    the WB4 hold scan in-kernel (stats.structure's depfuse path).
 
-    A char is in a word if alphanumeric/underscore, or a UAX#29-lite mid
+    A char is word-raw if alphanumeric/underscore, or a UAX#29-lite mid
     character flanked by the right neighbor classes.
     """
     word = ((cls & ALNUM) != 0) | (cps == ord("_"))
@@ -414,12 +418,19 @@ def word_mask(cps: jax.Array, cls: jax.Array) -> jax.Array:
         & ((next_cls & DIGIT) != 0)
     )
     word = word | letter_ok | num_ok
-
-    # UAX#29 WB4 (lite): Extend/Format chars inherit the wordness of the
-    # nearest preceding non-Extend char (utils.text._attach_extend twin).
-    # ``word`` is always False at Extend positions, so a segmented or-scan
-    # that RESETS at non-Extend positions holds each word flag through the
-    # following Extend run (leading Extend runs hold 0).
     ext = (cls & EXTEND) != 0
+    return word, ext
+
+
+def word_mask(cps: jax.Array, cls: jax.Array) -> jax.Array:
+    """In-word mask — the device twin of ``utils.text._word_mask``.
+
+    UAX#29 WB4 (lite): Extend/Format chars inherit the wordness of the
+    nearest preceding non-Extend char (utils.text._attach_extend twin).
+    ``word`` is always False at Extend positions, so a segmented or-scan
+    that RESETS at non-Extend positions holds each word flag through the
+    following Extend run (leading Extend runs hold 0).
+    """
+    word, ext = word_base(cps, cls)
     held = seg_scan_or(word.astype(jnp.int32), ~ext)
     return jnp.where(ext, held > 0, word)

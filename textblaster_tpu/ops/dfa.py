@@ -17,7 +17,27 @@ import numpy as np
 from .device import assoc_scan1, latch_scan, use_sort_tables
 from .pallas_scan import dfa_compose_scan, pallas_scan_ok
 
-__all__ = ["dfa_states", "citation_spans"]
+__all__ = ["dfa_packed_fns", "dfa_states", "citation_spans"]
+
+
+def dfa_packed_fns(char_classes: jax.Array, transition: np.ndarray) -> jax.Array:
+    """Nibble-packed per-char transition maps for a <= 8-state DFA.
+
+    This is exactly the operand stream :func:`dfa_states` composes (state
+    ``s``'s successor in bits ``4s..4s+3``), exposed so multi-pass chain
+    programs (pallas_scan.chain_scan) can run the DFA composition as one
+    group of a larger kernel and derive downstream operands from the packed
+    state in-register.  ``(packed >> (4 * start_state)) & 15`` recovers the
+    inclusive state stream.
+    """
+    n_states = transition.shape[1]
+    if n_states > 8:
+        raise ValueError("packed DFA maps require <= 8 states")
+    packed_rows = np.zeros(transition.shape[0], dtype=np.int64)
+    for s in range(n_states):
+        packed_rows |= transition[:, s].astype(np.int64) << (4 * s)
+    table = jnp.asarray(packed_rows.astype(np.int32))
+    return table[char_classes]
 
 
 def dfa_states(
@@ -40,11 +60,7 @@ def dfa_states(
     """
     n_states = transition.shape[1]
     if n_states <= 8:
-        packed_rows = np.zeros(transition.shape[0], dtype=np.int64)
-        for s in range(n_states):
-            packed_rows |= transition[:, s].astype(np.int64) << (4 * s)
-        table = jnp.asarray(packed_rows.astype(np.int32))
-        fns = table[char_classes]  # [B, L] int32, one packed map per char
+        fns = dfa_packed_fns(char_classes, transition)  # [B, L] packed maps
 
         def compose(a, b):
             # (b . a)(s) = b[a[s]]: route each of a's nibbles through b.

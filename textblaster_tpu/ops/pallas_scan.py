@@ -35,6 +35,10 @@ Escape hatches / fallback:
   disables every Pallas kernel — callers fall back to the lax scans.
 * ``TEXTBLAST_FUSED=off`` disables only the fused megakernel — the
   per-scan kernels (and their lax fallbacks) still run.
+* ``TEXTBLAST_DEPFUSE=off`` disables only the *dependency-chained*
+  multi-pass megakernel (:func:`chain_scan`) — callers fall back to the
+  staged schedule (which may still use :func:`fused_scan` for its
+  independent groups).
 * Non-TPU backends fall back automatically.  ``TEXTBLAST_PALLAS_INTERPRET=1``
   forces the interpret-mode kernel anywhere — how the fuzz suite runs the
   exact kernel program under tier-1 on CPU.
@@ -55,7 +59,7 @@ import functools
 import logging
 import os
 import threading
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -74,10 +78,17 @@ from .pallas_sort import (
 logger = logging.getLogger(__name__)
 
 __all__ = [
+    "Tap",
     "add_group",
     "affine_group",
     "affine_hash_scan",
+    "chain_group",
+    "chain_pass",
+    "chain_scan",
+    "chain_scan_ok",
+    "copy_group",
     "count_scan_dispatches",
+    "depfuse_enabled",
     "dfa_compose_scan",
     "dfa_group",
     "fused_enabled",
@@ -87,6 +98,7 @@ __all__ = [
     "pallas_scan_ok",
     "pallas_scan_supported",
     "record_scan_dispatch",
+    "segmax_group",
 ]
 
 #: Lanes per in-kernel scan block.  Blocked doubling costs
@@ -297,6 +309,18 @@ def _dfa_ident(n_states: int) -> int:
     return ident
 
 
+#: Identity for the segmented-max value stream: max(_I32_MIN, x) == x.
+_I32_MIN = -(2**31)
+
+
+def _segmax_op(xs, ys):
+    # Segmented running max over (value, reset) pairs — the kernel twin of
+    # device._seg_max_op (reset-as-int32, same select/or formulation).
+    av, ar = xs
+    bv, br = ys
+    return (jnp.where(br != 0, bv, jnp.maximum(av, bv)), ar | br)
+
+
 # --- fused multi-group megakernel -------------------------------------------
 #
 # A "group" is one independent associative scan over one or more int32
@@ -328,12 +352,17 @@ def dfa_group(fns: jax.Array, n_states: int, emit: str = "scan") -> dict:
     return {"kind": "dfa", "xs": (fns,), "emit": emit, "n_states": n_states}
 
 
-def _group_spec(g: dict) -> Tuple[Callable, Tuple[int, ...], int, Tuple[int, ...], bool]:
-    """(op, identities, n_inputs, emitted stream indices, emit_last)."""
+def _group_spec(g: dict) -> Tuple[Optional[Callable], Tuple[int, ...], int, Tuple[int, ...], bool]:
+    """(op, identities, n_operands, emitted stream indices, emit_last).
+
+    ``n_operands`` counts the streams the associative op runs over — for
+    chain groups with a ``prep`` this is ``g["n_ops"]`` (what prep returns),
+    not the dep count.  ``emit="none"`` behaves like "scan" in-kernel but
+    the chain layer stages the stream through scratch instead of HBM."""
     kind = g["kind"]
-    n_in = len(g["xs"])
+    n_in = g.get("n_ops", len(g["xs"]))
     emit = g.get("emit", "scan")
-    if emit not in ("scan", "last"):
+    if emit not in ("scan", "last", "none"):
         raise ValueError(f"unknown emit mode {emit!r}")
     emit_last = emit == "last"
     if kind == "affine":
@@ -343,6 +372,14 @@ def _group_spec(g: dict) -> Tuple[Callable, Tuple[int, ...], int, Tuple[int, ...
     if kind == "dfa":
         n_states = g["n_states"]
         return _dfa_op(n_states), (_dfa_ident(n_states),), 1, (0,), emit_last
+    if kind == "segmax":
+        return _segmax_op, (_I32_MIN, 0), 2, (0,), emit_last
+    if kind == "copy":
+        # Elementwise pass-through (no doubling, no carry): materializes a
+        # prep-derived stream so later passes can tap it.
+        if emit_last:
+            raise ValueError("copy groups cannot emit='last'")
+        return None, (0,) * n_in, n_in, tuple(range(n_in)), False
     raise ValueError(f"unknown fused group kind {kind!r}")
 
 
@@ -484,6 +521,7 @@ def _env_hatches() -> Tuple[str, ...]:
         os.environ.get("TEXTBLAST_NO_PALLAS", ""),
         os.environ.get("TEXTBLAST_PALLAS_INTERPRET", ""),
         os.environ.get("TEXTBLAST_FUSED", ""),
+        os.environ.get("TEXTBLAST_DEPFUSE", ""),
     )
 
 
@@ -646,3 +684,406 @@ def fused_scan(groups: Sequence[dict]) -> List[Tuple[jax.Array, ...]]:
     else:
         flat = _fused_call(groups, interpret)
     return _regroup(groups, flat)
+
+
+# --- dependency-chained multi-pass megakernel --------------------------------
+#
+# fused_scan only fuses *independent* groups: a scan whose operands derive
+# from another scan's output still pays a separate dispatch with an HBM
+# round-trip between the two.  chain_scan lifts that restriction: a chain is
+# an ordered list of passes, and a pass's groups may consume earlier passes'
+# emitted streams through Tap references — resolved in-kernel against the
+# output (or VMEM scratch) row tile, which the earlier pass has fully
+# written by the time the later pass's fori_loop starts.  The whole chain is
+# ONE pallas_call: the GopherRepetition hash -> n-gram dedup feeders, the
+# word-cumsum -> n_words consumers, and the sentence-DFA -> compaction
+# handoff each walk the packed tile once instead of 2-4 staged dispatches.
+#
+# Orientation: every stream (external or emitted) is stored in natural lane
+# order.  A pass with reverse=True *walks* the row tile back-to-front (its
+# lane blocks are loaded mirrored + flipped into "walk order", scanned, and
+# written back flipped), which computes the staged ``rev(scan(rev(x)))``
+# idiom bit-exactly while emitting the result already in natural
+# orientation.  Prep callables always see walk-ordered blocks; since they
+# are elementwise, flip commutes and parity is preserved.
+#
+# Tap(pass_idx, out_idx, shift, fill) addresses the ``out_idx``-th emitted
+# stream (flattened over that pass's groups, all emit modes counted) of an
+# earlier pass.  shift=1 reads the stream at the *previous walk position*
+# (the staged ``_shift_r`` in a forward pass, ``_shift_l`` in a reverse
+# pass), with ``fill`` injected at walk position 0.  Shifted *external*
+# operands never need kernel support — callers pre-shift them on the host
+# (elementwise, exact).  emit="none" streams are tap-only: they live in VMEM
+# scratch (``pltpu.VMEM``) and never touch HBM; when pltpu is unavailable
+# (interpret-only platforms) they degrade to discarded outputs.
+
+
+class Tap(NamedTuple):
+    """Reference to an earlier chain pass's emitted stream (see above)."""
+
+    pass_idx: int
+    out_idx: int
+    shift: int = 0
+    fill: int = 0
+
+
+def chain_group(
+    kind: str,
+    deps: Sequence,
+    prep: Optional[Callable] = None,
+    n_ops: Optional[int] = None,
+    emit: str = "scan",
+    n_states: Optional[int] = None,
+) -> dict:
+    """A chain-pass scan group.  ``deps`` mixes ``[B, L]`` arrays (external
+    operands) and :class:`Tap` references; ``prep`` (elementwise, walk-frame)
+    maps the loaded dep blocks to the op's ``n_ops`` operand streams —
+    omitted, the deps are the operands directly."""
+    g = {"kind": kind, "xs": tuple(deps), "emit": emit}
+    if n_states is not None:
+        g["n_states"] = n_states
+    if prep is not None:
+        if n_ops is None:
+            raise ValueError("chain_group with prep= requires n_ops=")
+        g["prep"] = prep
+        g["n_ops"] = n_ops
+    return g
+
+
+def segmax_group(v, r, emit: str = "scan") -> dict:
+    """Segmented running-max group over (value, reset) — the fused twin of
+    ``device.seg_scan_max``."""
+    return {"kind": "segmax", "xs": (v, r), "emit": emit}
+
+
+def copy_group(vals: Sequence, emit: str = "none") -> dict:
+    """Elementwise materialization group (no scan): stages prep-derived
+    streams so later passes can tap them."""
+    return {"kind": "copy", "xs": tuple(vals), "emit": emit}
+
+
+def chain_pass(groups: Sequence[dict], reverse: bool = False) -> dict:
+    """One pass of a :func:`chain_scan` program."""
+    return {"groups": list(groups), "reverse": bool(reverse)}
+
+
+def _chain_plan(passes: Sequence[dict]):
+    """Resolve a chain program statically: dedup external arrays (by object
+    identity), assign every emitted stream to an output or scratch slot, and
+    produce the kernel plan plus the caller-facing result layout."""
+    ext_arrays: List[jax.Array] = []
+    ext_index: Dict[int, int] = {}
+    stream_table: List[List[Tuple[Tuple[str, int], str]]] = []
+    out_modes: List[str] = []  # per out slot: "scan" | "last" | "drop"
+    n_scratch = 0
+    plan = []
+    layout: List[List[List[int]]] = []
+    use_scratch = pltpu is not None
+    for p_idx, pss in enumerate(passes):
+        groups_plan = []
+        pass_streams: List[Tuple[Tuple[str, int], str]] = []
+        pass_layout: List[List[int]] = []
+        for g in pss["groups"]:
+            spec = _group_spec(g)
+            emit = g.get("emit", "scan")
+            deps = []
+            for d in g["xs"]:
+                if isinstance(d, Tap):
+                    if not 0 <= d.pass_idx < p_idx:
+                        raise ValueError(
+                            f"Tap(pass_idx={d.pass_idx}) must reference an "
+                            f"earlier pass (current pass {p_idx})"
+                        )
+                    if d.shift not in (0, 1):
+                        raise ValueError("Tap.shift must be 0 or 1")
+                    storage, s_emit = stream_table[d.pass_idx][d.out_idx]
+                    if s_emit == "last":
+                        raise ValueError("cannot tap an emit='last' stream")
+                    deps.append(("s", storage, d.shift, int(d.fill)))
+                else:
+                    key = id(d)
+                    if key not in ext_index:
+                        ext_index[key] = len(ext_arrays)
+                        ext_arrays.append(d)
+                    deps.append(("e", ext_index[key]))
+            streams: List[Tuple[str, int]] = []
+            g_layout: List[int] = []
+            for _ in spec[3]:
+                if emit == "none" and use_scratch:
+                    storage = ("scratch", n_scratch)
+                    n_scratch += 1
+                else:
+                    storage = ("out", len(out_modes))
+                    out_modes.append("drop" if emit == "none" else emit)
+                    if emit != "none":
+                        g_layout.append(storage[1])
+                streams.append(storage)
+                pass_streams.append((storage, emit))
+            groups_plan.append(
+                {"spec": spec, "prep": g.get("prep"), "deps": deps, "streams": streams}
+            )
+            pass_layout.append(g_layout)
+        stream_table.append(pass_streams)
+        plan.append({"reverse": bool(pss.get("reverse", False)), "groups": groups_plan})
+        layout.append(pass_layout)
+    if not ext_arrays:
+        raise ValueError("chain_scan needs at least one external operand")
+    return plan, ext_arrays, out_modes, n_scratch, layout
+
+
+def _chain_body(plan, refs, n_ext: int, n_out: int) -> None:
+    """Kernel body: sequential per-pass fori_loops over one VMEM-resident
+    row tile.  Pass p fully writes its emitted streams (output or scratch
+    refs) before pass p+1's loop starts, so taps — including block-crossing
+    shift taps — read settled data without leaving the kernel."""
+    in_refs = refs[:n_ext]
+    out_refs = refs[n_ext : n_ext + n_out]
+    scratch_refs = refs[n_ext + n_out :]
+    rows, length = in_refs[0].shape
+    blk = _blk_for(length)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (rows, blk), 1)
+
+    def ref_for(storage):
+        return out_refs[storage[1]] if storage[0] == "out" else scratch_refs[storage[1]]
+
+    for pss in plan:
+        reverse = pss["reverse"]
+        groups = pss["groups"]
+
+        def load(ref, b_i, shift, fill):
+            start = b_i * blk
+            if reverse:
+                # Mirrored block, flipped into walk order: walk lane w of
+                # block b_i is natural lane length-1-(b_i*blk+w).
+                x = jnp.flip(ref[:, pl.ds(length - start - blk, blk)], axis=1)
+            else:
+                x = ref[:, pl.ds(start, blk)]
+            if shift:
+                # Previous-walk-position value: the natural lane just past
+                # this block's walk start (clamped; unused when b_i == 0,
+                # where ``fill`` is injected instead).
+                if reverse:
+                    prev_idx = jnp.minimum(length - start, length - 1)
+                else:
+                    prev_idx = jnp.maximum(start - 1, 0)
+                prev = jnp.where(
+                    b_i == 0,
+                    jnp.full((rows, 1), fill, jnp.int32),
+                    ref[:, pl.ds(prev_idx, 1)],
+                )
+                x = jnp.where(lane < 1, prev, roll_lanes(x, 1))
+            return x
+
+        def body(b_i, carry):
+            start = b_i * blk
+            new_carry = []
+            for gi, g in enumerate(groups):
+                op, identities, _, emit_idx, emit_last = g["spec"]
+                blocks = []
+                for d in g["deps"]:
+                    if d[0] == "e":
+                        blocks.append(load(in_refs[d[1]], b_i, 0, 0))
+                    else:
+                        blocks.append(load(ref_for(d[1]), b_i, d[2], d[3]))
+                prep = g["prep"]
+                xs = tuple(prep(*blocks)) if prep is not None else tuple(blocks)
+                xs = tuple(jnp.asarray(x).astype(jnp.int32) for x in xs)
+                if op is not None:
+                    idents = tuple(jnp.int32(v) for v in identities)
+                    d2 = 1
+                    while d2 < blk:
+                        shifted = tuple(
+                            jnp.where(lane >= d2, roll_lanes(x, d2), ident)
+                            for x, ident in zip(xs, idents)
+                        )
+                        xs = op(shifted, xs)
+                        d2 *= 2
+                    xs = op(carry[gi], xs)
+                if not emit_last:
+                    for storage, x_idx in zip(g["streams"], emit_idx):
+                        r = ref_for(storage)
+                        if reverse:
+                            r[:, pl.ds(length - start - blk, blk)] = jnp.flip(
+                                xs[x_idx], axis=1
+                            )
+                        else:
+                            r[:, pl.ds(start, blk)] = xs[x_idx]
+                new_carry.append(
+                    tuple(x[:, blk - 1 : blk] for x in xs) if op is not None else ()
+                )
+            return tuple(new_carry)
+
+        init = tuple(
+            tuple(jnp.full((rows, 1), v, jnp.int32) for v in g["spec"][1])
+            if g["spec"][0] is not None
+            else ()
+            for g in groups
+        )
+        final = jax.lax.fori_loop(0, length // blk, body, init)
+        for gi, g in enumerate(groups):
+            _, _, _, emit_idx, emit_last = g["spec"]
+            if emit_last:
+                for storage, x_idx in zip(g["streams"], emit_idx):
+                    ref_for(storage)[:, :] = final[gi][x_idx]
+
+
+def _chain_call(plan, ext_arrays, out_modes, n_scratch: int, interpret: bool):
+    b, length = ext_arrays[0].shape
+    n_ext = len(ext_arrays)
+    n_out = len(out_modes)
+
+    def kernel(*refs):
+        _chain_body(plan, refs, n_ext, n_out)
+
+    row_spec = pl.BlockSpec((ROWS, length), lambda i: (i, 0))
+    last_spec = pl.BlockSpec((ROWS, 1), lambda i: (i, 0))
+    out_specs = [last_spec if m == "last" else row_spec for m in out_modes]
+    out_shapes = [
+        jax.ShapeDtypeStruct((b, 1) if m == "last" else (b, length), jnp.int32)
+        for m in out_modes
+    ]
+    kwargs = {}
+    if n_scratch:
+        kwargs["scratch_shapes"] = [pltpu.VMEM((ROWS, length), jnp.int32)] * n_scratch
+    return tuple(
+        pl.pallas_call(
+            kernel,
+            grid=(b // ROWS,),
+            in_specs=[row_spec] * n_ext,
+            out_specs=out_specs,
+            out_shape=out_shapes,
+            interpret=interpret,
+            **kwargs,
+        )(*(x.astype(jnp.int32) for x in ext_arrays))
+    )
+
+
+def chain_scan(passes: Sequence[dict]) -> List[List[Tuple[jax.Array, ...]]]:
+    """Evaluate a dependency-chained multi-pass program in ONE kernel
+    dispatch — see the section comment above.  Returns, per pass, one tuple
+    of emitted int32 arrays per group (``[B, L]`` for emit="scan", ``[B, 1]``
+    for emit="last"; emit="none" streams are tap-only and omitted).  Every
+    external operand must be ``[B, L]``.  Callers gate on
+    :func:`chain_scan_ok` first."""
+    record_scan_dispatch("fused")
+    plan, ext_arrays, out_modes, n_scratch, layout = _chain_plan(passes)
+    interpret = interpret_forced()
+    mesh = _current_mesh()
+    if mesh is not None:
+        def fn(*xs):
+            return _chain_call(plan, tuple(xs), out_modes, n_scratch, interpret)
+
+        flat = tuple(_shard_mapped(fn, mesh, tuple(ext_arrays), len(out_modes)))
+    else:
+        flat = _chain_call(plan, tuple(ext_arrays), out_modes, n_scratch, interpret)
+    return [
+        [tuple(flat[s] for s in g_slots) for g_slots in p_layout]
+        for p_layout in layout
+    ]
+
+
+def depfuse_enabled() -> bool:
+    """``TEXTBLAST_DEPFUSE=off`` (or ``0``/``false``) disables the
+    dependency-chained multi-pass megakernel only; re-read per call so
+    tests/benches can toggle it."""
+    return os.environ.get("TEXTBLAST_DEPFUSE", "").lower() not in ("off", "0", "false")
+
+
+@functools.lru_cache(maxsize=32)
+def _probe_depfuse_cached(env: Tuple[str, ...], backend: str) -> bool:
+    """Probe the chain kernel specifically: reverse-walk passes (lane
+    flips), cross-pass taps, shift taps, VMEM scratch staging, and the
+    segmented-max op exercise Mosaic surface the fused probe never
+    touches."""
+    del env
+    if pltpu is None or backend == "cpu":
+        return False
+    try:
+        with jax.ensure_compile_time_eval():
+            L = 256
+            iota = jax.lax.broadcasted_iota(jnp.int32, (ROWS, L), 1)
+            vals = (iota * 7 + 3) % 97
+            reset = ((iota % 64) == 0).astype(jnp.int32)
+            m = jnp.where(reset != 0, 0, 1)
+            probe_passes = [
+                    chain_pass([{"kind": "affine", "xs": (m, vals), "emit": "none"}]),
+                    chain_pass(
+                        [
+                            chain_group(
+                                "segmax",
+                                (Tap(0, 0), reset),
+                                prep=lambda seg, r: (jnp.where(r != 0, seg, 0), r),
+                                n_ops=2,
+                            )
+                        ],
+                        reverse=True,
+                    ),
+                    chain_pass(
+                        [
+                            chain_group(
+                                "copy",
+                                (Tap(1, 0), Tap(0, 0, shift=1, fill=0)),
+                                prep=lambda rt, prev: (rt + prev,),
+                                n_ops=1,
+                                emit="scan",
+                            ),
+                            chain_group(
+                                "add",
+                                (Tap(1, 0),),
+                                prep=lambda rt: (jnp.where(rt > 50, 1, 0),),
+                                n_ops=1,
+                                emit="last",
+                            ),
+                        ]
+                    ),
+                ]
+            plan, ext, modes, n_scr, layout = _chain_plan(probe_passes)
+            flat = _chain_call(plan, tuple(ext), modes, n_scr, interpret=False)
+            got = [
+                [tuple(flat[s] for s in g_slots) for g_slots in p_layout]
+                for p_layout in layout
+            ]
+            seg = jax.lax.associative_scan(_affine_op, (m, vals), axis=1)[1]
+            rt = jnp.flip(
+                jax.lax.associative_scan(
+                    _segmax_op,
+                    (
+                        jnp.flip(jnp.where(reset != 0, seg, 0), 1),
+                        jnp.flip(reset, 1),
+                    ),
+                    axis=1,
+                )[0],
+                1,
+            )
+            prev = jnp.concatenate([jnp.zeros((ROWS, 1), jnp.int32), seg[:, :-1]], 1)
+            ok = (
+                bool(jnp.array_equal(got[2][0][0], rt + prev))
+                and bool(
+                    jnp.array_equal(
+                        got[2][1][0],
+                        jnp.sum(jnp.where(rt > 50, 1, 0), axis=1, keepdims=True),
+                    )
+                )
+                and bool(jnp.array_equal(got[1][0][0], rt))
+            )
+        if not ok:  # pragma: no cover - would be a Mosaic miscompile
+            logger.warning("chain scan probe mismatch; using staged scans")
+        return ok
+    except Exception as e:  # pragma: no cover - backend-specific
+        logger.warning("chain scan unavailable on %s: %s", backend, e)
+        return False
+
+
+def _probe_depfuse() -> bool:
+    return _probe_depfuse_cached(_env_hatches(), jax.default_backend())
+
+
+def chain_scan_ok(b: int, length: int) -> bool:
+    """Gate for :func:`chain_scan` — the fused gate (so ``TEXTBLAST_FUSED``
+    and the mesh/shape rules compose) plus the dependency-fusion hatch and
+    its own backend probe."""
+    if not depfuse_enabled():
+        return False
+    if not fused_scan_ok(b, length):
+        return False
+    return interpret_forced() or _probe_depfuse()

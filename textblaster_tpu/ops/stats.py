@@ -193,6 +193,20 @@ def _sum_group(values: Tuple[jax.Array, ...]) -> dict:
     return add_group(tuple(v.astype(jnp.int32) for v in values), emit="last")
 
 
+def _pattern_hash_group(src: jax.Array, mask: jax.Array) -> dict:
+    """Chain-group twin of ``_pattern_union_starts``' candidate prefix hash
+    (same m/acc construction as its ``_poly_hash`` call), so the candidate
+    pass can ride another kernel's dispatch via the ``h_inc`` parameter."""
+    first = jnp.zeros_like(mask).at[:, 0].set(True)
+    return {
+        "kind": "affine",
+        "xs": (
+            jnp.where(first, 0, 31).astype(jnp.int32),
+            jnp.where(mask, src, 0).astype(jnp.int32),
+        ),
+    }
+
+
 def _scatter(values, idx, active, m, fill=0, op="set"):
     """Scatter per-char ``values`` at ``active`` positions into ``[B, m]``
     slots keyed by ``idx``.  With op="set", callers must guarantee one active
@@ -287,10 +301,158 @@ def structure(
     cls = classify(cps)
     cls = jnp.where(mask, cls, 0).astype(cls.dtype)
 
-    in_word = word_mask(cps, cls) & mask
+    from .pallas_scan import (
+        Tap,
+        chain_group,
+        chain_pass,
+        chain_scan,
+        chain_scan_ok,
+        fused_scan,
+        fused_scan_ok,
+    )
+
+    if with_hashes:
+        lt = lower_table()
+        low = lt[jnp.minimum(cps, lt.shape[0] - 1)]
+
     ws = (cls & WS) != 0
     punct = (cls & PUNCT) != 0
     ext = ((cls & EXTEND) != 0) & mask
+
+    if chain_scan_ok(*cps.shape) and length <= 8192:
+        # Dependency-fused path: the whole unit-segmentation chain — the WB4
+        # word hold scan, the symbol hold scan it feeds, the per-unit
+        # aggregate/hash scans those masks gate, the unit_end/valid_end
+        # derivation (a reverse pass: "next" lane values are walk-previous
+        # taps), and the word-cumsum -> n_words consumers — runs as ONE
+        # multi-pass kernel dispatch.  Every recurrence below restates the
+        # staged branch's op exactly (segmented OR of {0,1} streams is a
+        # segmented SUM compared > 0), so the streams are bit-identical.
+        from .device import word_base
+
+        word_raw, _ = word_base(cps, cls)
+        ext_i = ext.astype(jnp.int32)
+        wm = (word_raw & mask).astype(jnp.int32)
+        base_raw = (~ws & ~punct & mask & (cps != 0x200B) & ~ext).astype(jnp.int32)
+        sh_ext = _shift_r(ext_i)
+        sh_wm = _shift_r(wm)
+        widths_raw = utf8_width(cps)
+        np_raw = (~punct).astype(jnp.int32)
+        alpha_raw = ((cls & ALPHA) != 0).astype(jnp.int32)
+
+        def _derive(held, hs, shh, e, w, br, she, shw):
+            # in_word / in_unit / unit_start from the held scans (staged
+            # twin formulas; XLA CSE dedups across the preps sharing them).
+            iw = jnp.where(e != 0, held > 0, w != 0)
+            bs = ~iw & (br != 0)
+            sym = bs | ((e != 0) & ~iw & (hs > 0))
+            iu = iw | sym
+            piw = jnp.where(she != 0, shh > 0, shw != 0)
+            us = (iw & ~piw) | bs
+            return iu, us
+
+        core = (
+            Tap(0, 0),  # held (WB4 word hold)
+            Tap(1, 0),  # held_sym
+            Tap(0, 0, shift=1, fill=0),  # held at the previous lane
+            ext_i,
+            wm,
+            base_raw,
+            sh_ext,
+            sh_wm,
+        )
+
+        def prep_sym(held, e, w, br):
+            iw = jnp.where(e != 0, held > 0, w != 0)
+            return e, (~iw & (br != 0)).astype(jnp.int32)
+
+        def prep_agg(held, hs, shh, e, w, br, she, shw, wd, np_, al):
+            iu, us = _derive(held, hs, shh, e, w, br, she, shw)
+            m = jnp.where(us, 0, 1)
+            acc1 = iu.astype(jnp.int32) * jnp.int32(1 << 17) + jnp.where(iu, wd, 0)
+            acc2 = jnp.where(iu, np_, 0) * jnp.int32(1 << 16) + jnp.where(iu, al, 0)
+            return m, acc1, acc2
+
+        def prep_hash(held, hs, shh, e, w, br, she, shw, c, lo):
+            iu, us = _derive(held, hs, shh, e, w, br, she, shw)
+            m = jnp.where(us, 0, jnp.where(iu, 31, 1))
+            return m, jnp.where(iu, c, 0), jnp.where(iu, lo, 0)
+
+        def prep_copy(held, hs, shh, e, w, br, she, shw):
+            iu, us = _derive(held, hs, shh, e, w, br, she, shw)
+            return iu.astype(jnp.int32), us.astype(jnp.int32)
+
+        p2_groups = [
+            chain_group("affine", core + (widths_raw, np_raw, alpha_raw),
+                        prep=prep_agg, n_ops=3),
+        ]
+        if with_hashes:
+            p2_groups.append(
+                chain_group("affine", core + (cps, low), prep=prep_hash, n_ops=3)
+            )
+        p2_groups.append(chain_group("copy", core, prep=prep_copy, n_ops=2))
+        s_iu = 4 if with_hashes else 2  # flat stream index of in_unit in pass 2
+        s_us = s_iu + 1
+
+        def prep_vend(iu, iu_next, us_next, pb):
+            ue = (iu != 0) & ((iu_next == 0) | (us_next != 0))
+            return (jnp.where(ue & ((pb >> 16) > 0), 1, 0),)
+
+        res = chain_scan(
+            [
+                chain_pass(
+                    [{"kind": "affine", "xs": (ext_i, wm), "emit": "none"}]
+                ),
+                chain_pass(
+                    [chain_group("affine", (Tap(0, 0), ext_i, wm, base_raw),
+                                 prep=prep_sym, n_ops=2, emit="none")]
+                ),
+                chain_pass(p2_groups),
+                chain_pass(
+                    [chain_group(
+                        "copy",
+                        (Tap(2, s_iu), Tap(2, s_iu, shift=1, fill=0),
+                         Tap(2, s_us, shift=1, fill=0), Tap(2, 1)),
+                        prep=prep_vend, n_ops=1, emit="none",
+                    )],
+                    reverse=True,
+                ),
+                chain_pass(
+                    [chain_group("add", (Tap(3, 0),), emit="scan")]
+                ),
+            ]
+        )
+        packed_a, packed_b = res[2][0]
+        unit_len = packed_a >> 17
+        unit_bytes = packed_a & jnp.int32((1 << 17) - 1)
+        unit_valid = (packed_b >> 16) > 0
+        unit_alpha = (packed_b & jnp.int32((1 << 16) - 1)) > 0
+        unit_hash, unit_lhash = res[2][1] if with_hashes else (None, None)
+        iu_s, us_s = res[2][-1]
+        in_unit = iu_s != 0
+        unit_start = us_s != 0
+        unit_end = in_unit & (~_shift_l(in_unit, False) | _shift_l(unit_start, False))
+        cs = res[4][0][0]
+        word_idx = cs - 1
+        n_words = cs[:, -1]
+
+        return TextStructure(
+            cps=cps,
+            lengths=lengths,
+            cls=cls,
+            mask=mask,
+            unit_end=unit_end,
+            unit_valid=unit_valid,
+            unit_len=unit_len,
+            unit_bytes=unit_bytes,
+            unit_hash=unit_hash,
+            unit_lhash=unit_lhash,
+            unit_alpha=unit_alpha,
+            n_words=n_words,
+            word_idx=word_idx,
+        )
+
+    in_word = word_mask(cps, cls) & mask
     # Symbols: not word/ws/punct; ZWSP yields no token (WordBreak=Other and
     # not word-like in ICU), bare Extend chars yield no token, and an Extend
     # run after a symbol CONTINUES that symbol's unit (WB4) — mirror of
@@ -310,12 +472,6 @@ def structure(
     widths = jnp.where(in_unit, utf8_width(cps), 0)
     nonpunct = jnp.where(in_unit, (~punct).astype(jnp.int32), 0)
     alpha = jnp.where(in_unit, ((cls & ALPHA) != 0).astype(jnp.int32), 0)
-
-    from .pallas_scan import fused_scan, fused_scan_ok
-
-    if with_hashes:
-        lt = lower_table()
-        low = lt[jnp.minimum(cps, lt.shape[0] - 1)]
 
     if fused_scan_ok(*cps.shape):
         # One kernel pass for every per-unit scan of this kernel: the packed
@@ -414,7 +570,7 @@ def _match_pattern(src: jax.Array, mask: jax.Array, pattern: str) -> jax.Array:
 
 
 def _pattern_union_starts(
-    src: jax.Array, mask: jax.Array, patterns: Tuple[str, ...]
+    src: jax.Array, mask: jax.Array, patterns: Tuple[str, ...], h_inc=None
 ) -> jax.Array:
     """[B, L] bool: some pattern in ``patterns`` starts at each position.
 
@@ -424,10 +580,15 @@ def _pattern_union_starts(
     exists.  Clean batches — the common case for lorem-ipsum / javascript /
     policy text — pay only the hash pass; decisions always come from the
     exact compare, so hash collisions cannot alter semantics.
+
+    ``h_inc`` optionally supplies the inclusive prefix hash precomputed by a
+    caller's chain kernel (operands per :func:`_pattern_hash_group`) so the
+    candidate pass rides an existing dispatch.
     """
     vals = jnp.where(mask, src, 0)
     first = jnp.zeros_like(mask).at[:, 0].set(True)
-    h_inc = _poly_hash(vals, jnp.ones_like(mask), first)  # inclusive prefix hash
+    if h_inc is None:
+        h_inc = _poly_hash(vals, jnp.ones_like(mask), first)  # inclusive prefix hash
     h_exc = _shift_r(h_inc, 0)  # hash of chars [0, i)
 
     def to_i32(u: int) -> np.int32:
@@ -584,18 +745,61 @@ def _dup_counts(seg_hash, seg_bytes, seg_valid, mesh=None) -> Tuple[jax.Array, j
     )
 
 
-def _top_duplicate_sorted(sorted_triple) -> jax.Array:
-    """find_top_duplicate semantics: bytes*count of the most frequent item,
-    ties by larger contribution, 0 when nothing repeats (text.rs:211-238)."""
-    is_real, s_hash, s_bytes = sorted_triple
-    run_start = jnp.concatenate(
+def _run_starts(s_hash: jax.Array) -> jax.Array:
+    """Run-start mask over a hash-sorted table (hash change or slot 0)."""
+    return jnp.concatenate(
         [
-            jnp.ones_like(is_real[:, :1]),
+            jnp.ones_like(s_hash[:, :1], dtype=bool),
             s_hash[:, 1:] != s_hash[:, :-1],
         ],
         axis=1,
     )
-    run_len = seg_scan_add(jnp.ones_like(s_hash), run_start)
+
+
+def _sorted_table_streams(tagged_triples, mesh=None):
+    """ONE chain dispatch for every per-run scan over the sorted tables:
+    run lengths for "top" jobs, first-window-index-in-run for "dup" jobs
+    (the staged scans inside _top_duplicate_sorted / _dup_run_info_sorted).
+
+    Returns a per-job list of precomputed streams, or ``None`` when the
+    table shape fails the chain gate — callers fall back to the staged
+    per-scan path, which computes the identical int32 recurrences.
+    """
+    from .pallas_scan import chain_pass, chain_scan, chain_scan_ok
+
+    if not tagged_triples:
+        return None
+    b, m = tagged_triples[0][1][1].shape
+    if not chain_scan_ok(b, m):
+        return None
+    groups = []
+    for kind, (is_real, s_hash, sidx) in tagged_triples:
+        rs = _run_starts(s_hash)
+        if kind == "top":
+            groups.append(
+                {
+                    "kind": "affine",
+                    "xs": (jnp.where(rs, 0, 1), jnp.ones_like(s_hash)),
+                }
+            )
+        else:
+            groups.append(
+                {
+                    "kind": "segmax",
+                    "xs": (jnp.where(rs, sidx, -(2**30)), rs.astype(jnp.int32)),
+                }
+            )
+    res = chain_scan([chain_pass(groups)])
+    return [g[0] for g in res[0]]
+
+
+def _top_duplicate_sorted(sorted_triple, run_len=None) -> jax.Array:
+    """find_top_duplicate semantics: bytes*count of the most frequent item,
+    ties by larger contribution, 0 when nothing repeats (text.rs:211-238)."""
+    is_real, s_hash, s_bytes = sorted_triple
+    run_start = _run_starts(s_hash)
+    if run_len is None:
+        run_len = seg_scan_add(jnp.ones_like(s_hash), run_start)
     run_end = _shift_l(run_start, True)
     counts = jnp.where(run_end & is_real, run_len, 0)
     max_count = jnp.max(counts, axis=1, keepdims=True)
@@ -633,6 +837,120 @@ def gopher_quality_stats(
         is_stop = isin_sorted(st.unit_lhash, sw)
     else:
         is_stop = None
+
+    from .pallas_scan import (
+        Tap,
+        chain_group,
+        chain_pass,
+        chain_scan,
+        chain_scan_ok,
+    )
+
+    if chain_scan_ok(*cps.shape):
+        # Dependency-chain kernel: the staged path runs the three line scans,
+        # then derives bullet/ellipsis line flags from their outputs on the
+        # host and sums them — two more full-width [B, L] round-trips.  Here
+        # a third pass consumes the counter streams in-register and emits
+        # only the [B, 1] totals; the dot-run stream is the single full-width
+        # output (its //3 consumer stays host-side: int32 division).
+        totals = [
+            ((cps == ord("#")) & mask).astype(jnp.int32),
+            ((cps == 0x2026) & mask).astype(jnp.int32),
+            jnp.where(valid_end, st.unit_len, 0).astype(jnp.int32),
+            (valid_end & st.unit_alpha).astype(jnp.int32),
+        ]
+        if is_stop is not None:
+            totals.append((valid_end & is_stop).astype(jnp.int32))
+        r_reset = _first_col(mask) | _shift_r(rev(li.is_nl), False)
+        nonws_i = nonws.astype(jnp.int32)
+        is_bullet_i = ((cps == 0x2022) | (cps == ord("-"))).astype(jnp.int32)
+        ell_cp_i = ((cps == 0x2026)).astype(jnp.int32)
+        is_dot_i = is_dot.astype(jnp.int32)
+
+        def _prep_line_flags(lead_cnt, cnt_r, dot_run_t, nw, bul, ell, dt):
+            leader_ = (nw != 0) & (lead_cnt == 1)
+            last_ = (nw != 0) & (cnt_r == 1)
+            return (
+                (leader_ & (bul != 0)).astype(jnp.int32),
+                (last_ & ((ell != 0) | ((dt != 0) & (dot_run_t >= 3)))).astype(
+                    jnp.int32
+                ),
+            )
+
+        res = chain_scan(
+            [
+                chain_pass(
+                    [
+                        _seg_add_group((is_dot_i,), dot_start),
+                        {
+                            "kind": "affine",
+                            "xs": (
+                                jnp.where(_line_reset(li, mask), 0, 1),
+                                nonws_i,
+                            ),
+                            "emit": "none",
+                        },
+                        _sum_group(tuple(totals)),
+                    ]
+                ),
+                chain_pass(
+                    [
+                        # Reversed per-line counter: operands in natural
+                        # orientation (the reverse pass walks them flipped),
+                        # so rev() of the staged reversed-frame operands.
+                        {
+                            "kind": "affine",
+                            "xs": (rev(jnp.where(r_reset, 0, 1)), nonws_i),
+                            "emit": "none",
+                        }
+                    ],
+                    reverse=True,
+                ),
+                chain_pass(
+                    [
+                        chain_group(
+                            "add",
+                            (
+                                Tap(0, 1),
+                                Tap(1, 0),
+                                Tap(0, 0),
+                                nonws_i,
+                                is_bullet_i,
+                                ell_cp_i,
+                                is_dot_i,
+                            ),
+                            prep=_prep_line_flags,
+                            n_ops=2,
+                            emit="last",
+                        )
+                    ]
+                ),
+            ]
+        )
+        (dot_run,) = res[0][0]
+        t = res[0][2]
+        hash_count = t[0][:, 0]
+        ellipsis_uni = t[1][:, 0]
+        sum_len = t[2][:, 0]
+        alpha_words = t[3][:, 0]
+        stop_words = t[4][:, 0] if is_stop is not None else jnp.zeros_like(n_words)
+        bullet_lines = res[2][0][0][:, 0]
+        ellipsis_lines = res[2][0][1][:, 0]
+        dot_end = is_dot & ~_shift_l(is_dot, False)
+        ellipsis_ascii = jnp.sum(jnp.where(dot_end, dot_run // 3, 0), axis=1)
+        ellipsis_units = (ellipsis_ascii + ellipsis_uni).astype(jnp.int32)
+        return {
+            "n_words": n_words,
+            "n_non_symbol": n_words,
+            "sum_word_len": sum_len,
+            "hash_count": hash_count,
+            "ellipsis_units": ellipsis_units,
+            "n_lines": li.n_lines,
+            "bullet_lines": bullet_lines,
+            "ellipsis_lines": ellipsis_lines,
+            "alpha_words": alpha_words,
+            "stop_words": stop_words,
+        }
 
     if fused_scan_ok(*cps.shape):
         # One kernel for the phase's three independent scans (dot runs,
@@ -851,19 +1169,121 @@ def gopher_rep_stats(
 
     # Paragraph separators: \n chars inside runs of >= 2.
     nl_start = is_nl & ~prev_nl
-    nl_run = seg_scan_add(is_nl.astype(jnp.int32), nl_start)
     nl_run_end = is_nl & ~_shift_l(is_nl, False)
-    run_total = rev(
-        seg_scan_max(rev(jnp.where(nl_run_end, nl_run, 0)), rev(nl_run_end))
-    )
+    widths = utf8_width(cps)
+
+    from .pallas_scan import Tap, chain_group, chain_pass, chain_scan, chain_scan_ok
+
+    if chain_scan_ok(*cps.shape):
+        # Dependency-chain megakernel: the nl-run counter, the reversed
+        # run-total broadcast, and the four line/paragraph segment scans (the
+        # paragraph pair depends on run_total through is_sep/p_start) walk
+        # the row tile in ONE dispatch instead of six.  Pass 0 counts
+        # newline runs; pass 1 (reverse) broadcasts each run's total back
+        # over its run; pass 2 derives the paragraph frame from run_total
+        # taps in-register and runs all four segment hash/byte scans.  Every
+        # operand restates the staged recurrence exactly (_seg_add_group
+        # note) — bit-identical by int32 associativity.
+        is_nl_i = is_nl.astype(jnp.int32)
+
+        def _prep_run_total(nl_run_t, ne):
+            return jnp.where(ne != 0, nl_run_t, 0), ne
+
+        def _para_frame(rt, sh_rt, nl, sh_nl, it, t0f):
+            sep = (nl != 0) & (rt >= 2)
+            sh_sep = (sh_nl != 0) & (sh_rt >= 2)
+            p_c = (it != 0) & ~sep
+            p_s = p_c & (sh_sep | (t0f != 0))
+            return p_c, p_s
+
+        def _prep_p_hash(rt, sh_rt, nl, sh_nl, it, t0f, c):
+            p_c, p_s = _para_frame(rt, sh_rt, nl, sh_nl, it, t0f)
+            return (
+                jnp.where(p_s, 0, jnp.where(p_c, 31, 1)),
+                jnp.where(p_c, c, 0),
+            )
+
+        def _prep_p_bytes(rt, sh_rt, nl, sh_nl, it, t0f, w):
+            p_c, p_s = _para_frame(rt, sh_rt, nl, sh_nl, it, t0f)
+            return jnp.where(p_s, 0, 1), jnp.where(p_c, w, 0)
+
+        para_deps = (
+            Tap(1, 0),
+            Tap(1, 0, shift=1, fill=0),
+            is_nl_i,
+            prev_nl.astype(jnp.int32),
+            in_trim.astype(jnp.int32),
+            at_t0.astype(jnp.int32),
+        )
+        res = chain_scan(
+            [
+                chain_pass(
+                    [
+                        {
+                            "kind": "affine",
+                            "xs": (jnp.where(nl_start, 0, 1), is_nl_i),
+                            "emit": "none",
+                        }
+                    ]
+                ),
+                chain_pass(
+                    [
+                        chain_group(
+                            "segmax",
+                            (Tap(0, 0), nl_run_end.astype(jnp.int32)),
+                            prep=_prep_run_total,
+                            n_ops=2,
+                        )
+                    ],
+                    reverse=True,
+                ),
+                chain_pass(
+                    [
+                        {
+                            "kind": "affine",
+                            "xs": (
+                                jnp.where(l_start, 0, jnp.where(l_content, 31, 1)),
+                                jnp.where(l_content, cps, 0),
+                            ),
+                        },
+                        {
+                            "kind": "affine",
+                            "xs": (
+                                jnp.where(l_start, 0, 1),
+                                jnp.where(l_content, widths, 0),
+                            ),
+                        },
+                        chain_group(
+                            "affine", para_deps + (cps,), prep=_prep_p_hash, n_ops=2
+                        ),
+                        chain_group(
+                            "affine", para_deps + (widths,), prep=_prep_p_bytes, n_ops=2
+                        ),
+                    ]
+                ),
+            ]
+        )
+        run_total = res[1][0][0]
+        l_pre = (res[2][0][0], res[2][1][0])
+        p_pre = (res[2][2][0], res[2][3][0])
+    else:
+        nl_run = seg_scan_add(is_nl.astype(jnp.int32), nl_start)
+        run_total = rev(
+            seg_scan_max(rev(jnp.where(nl_run_end, nl_run, 0)), rev(nl_run_end))
+        )
+        l_pre = p_pre = None
+
     is_sep = is_nl & (run_total >= 2)
     p_content = in_trim & ~is_sep
     p_start = p_content & (_shift_r(is_sep, False) | at_t0)
 
-    def seg_values(content, start):
+    def seg_values(content, start, pre=None):
         end = content & ~_shift_l(content, False)
-        h = _poly_hash(cps, content, start)
-        by = seg_scan_add(jnp.where(content, utf8_width(cps), 0), start)
+        if pre is not None:
+            h, by = pre
+        else:
+            h = _poly_hash(cps, content, start)
+            by = seg_scan_add(jnp.where(content, widths, 0), start)
         n = jnp.sum(start, axis=1).astype(jnp.int32)
         return end, h, by, n
 
@@ -875,8 +1295,8 @@ def gopher_rep_stats(
         tbl_valid = jnp.arange(max_segs, dtype=jnp.int32)[None, :] < n[:, None]
         return tbl_h, tbl_b, tbl_valid, n
 
-    l_end, l_h, l_by, n_l = seg_values(l_content, l_start)
-    p_end, p_h, p_by, n_p = seg_values(p_content, p_start)
+    l_end, l_h, l_by, n_l = seg_values(l_content, l_start, l_pre)
+    p_end, p_h, p_by, n_p = seg_values(p_content, p_start, p_pre)
     if use_sort_tables():
         # Segments are non-empty char runs, so seg ids are gapless 0..n-1 and
         # slot j == the j-th segment end — identical to the scatter layout.
@@ -974,12 +1394,22 @@ def gopher_rep_stats(
             tags.append(("dup", n))
 
     dup_min_flags = dup_min_rid = None
-    for (kind, n), srt in zip(tags, _sort_runs_many(jobs, mesh=mesh) if jobs else ()):
+    srts = _sort_runs_many(jobs, mesh=mesh) if jobs else []
+    # All post-sort per-run scans (top-n run lengths + min-dup run ids) fuse
+    # into one chain dispatch over the stacked tables when the table shape
+    # passes the gate; None falls back to the identical staged scans.
+    pre = _sorted_table_streams(
+        [(kind, srt) for (kind, _), srt in zip(tags, srts)], mesh=mesh
+    )
+    for i, ((kind, n), srt) in enumerate(zip(tags, srts)):
         if kind == "top":
-            out[f"top_{n}"] = _top_duplicate_sorted(srt)
+            out[f"top_{n}"] = _top_duplicate_sorted(
+                srt, run_len=pre[i] if pre else None
+            )
         else:
             dup_min_flags, dup_min_rid = _dup_run_info_sorted(
-                srt, grams[n][2], idx, mesh=mesh
+                srt, grams[n][2], idx, mesh=mesh,
+                first_in_run=pre[i] if pre else None,
             )
 
     if dup_sizes:
@@ -990,8 +1420,15 @@ def gopher_rep_stats(
             walk = [(min_dup, min_rid, grams[min_dup][2], grams[min_dup][1])]
             if rest:
                 rjobs = [(grams[n][0], idx, grams[n][2]) for n in rest]
-                for n, srt in zip(rest, _sort_runs_many(rjobs, mesh=mesh)):
-                    _, rid_n = _dup_run_info_sorted(srt, grams[n][2], idx, mesh=mesh)
+                rsrts = _sort_runs_many(rjobs, mesh=mesh)
+                rpre = _sorted_table_streams(
+                    [("dup", srt) for srt in rsrts], mesh=mesh
+                )
+                for i, (n, srt) in enumerate(zip(rest, rsrts)):
+                    _, rid_n = _dup_run_info_sorted(
+                        srt, grams[n][2], idx, mesh=mesh,
+                        first_in_run=rpre[i] if rpre else None,
+                    )
                     walk.append((n, rid_n, grams[n][2], grams[n][1]))
             res = _find_all_dup_bytes_batched(walk)
             return tuple(res[f"dup_{n}"] for n in dup_sizes)
@@ -1009,7 +1446,7 @@ def gopher_rep_stats(
 
 
 def _dup_run_info_sorted(
-    sorted_triple, win_valid, idx, mesh=None
+    sorted_triple, win_valid, idx, mesh=None, first_in_run=None
 ) -> Tuple[jax.Array, jax.Array]:
     """``(flags, run_first)`` from a ``(hash, idx)``-sorted window table:
     ``flags`` — "an earlier identical window exists" (a superset of
@@ -1018,15 +1455,10 @@ def _dup_run_info_sorted(
     its hash), the canonical slot for the walk's visited table."""
     is_real, s_hash, sidx = sorted_triple
     b, m = s_hash.shape
-    run_start = jnp.concatenate(
-        [
-            jnp.ones((b, 1), dtype=bool),
-            s_hash[:, 1:] != s_hash[:, :-1],
-        ],
-        axis=1,
-    )
-    # Sorted by (hash, idx): the run's first slot holds the minimum index.
-    first_in_run = seg_scan_max(jnp.where(run_start, sidx, -(2**30)), run_start)
+    run_start = _run_starts(s_hash)
+    if first_in_run is None:
+        # Sorted by (hash, idx): the run's first slot holds the minimum index.
+        first_in_run = seg_scan_max(jnp.where(run_start, sidx, -(2**30)), run_start)
     if use_sort_tables():
         # Un-sort by window index instead of scattering: the real entries'
         # sidx values are exactly 0..n_valid-1 (win_valid is a prefix mask),
@@ -1169,18 +1601,137 @@ def sentence_boundaries(cps: jax.Array, mask: jax.Array, cls: jax.Array) -> jax.
     return (candidate & ~no_break) | (_shift_r(psep, False) & mask)
 
 
+def _sentence_frame(cps: jax.Array, mask: jax.Array, cls: jax.Array) -> dict:
+    """Elementwise operands of :func:`sentence_boundaries`, shared between
+    the staged path and the chain kernel (all int32, kernel-ready)."""
+    from .dfa import dfa_packed_fns
+
+    term = isin_sorted(cps, jnp.asarray(_TERM_SET)) & mask
+    sterm = isin_sorted(cps, jnp.asarray(_STERM_SET)) & mask
+    close = isin_sorted(cps, jnp.asarray(_CLOSE_SET)) & mask
+    sp = isin_sorted(cps, jnp.asarray(_SP_SET)) & mask
+    psep = isin_sorted(cps, jnp.asarray(_PSEP_SET)) & mask
+
+    sym = jnp.zeros_like(cps)
+    sym = jnp.where(term, 1, sym)
+    sym = jnp.where(close & ~term, 2, sym)
+    sym = jnp.where(sp & ~close & ~term, 3, sym)
+    return {
+        "fns": dfa_packed_fns(sym, _SENT_T),
+        "term": term.astype(jnp.int32),
+        "sterm": sterm.astype(jnp.int32),
+        "lower": ((cls & LOWER) != 0).astype(jnp.int32),
+        "alnum": (((cls & ALNUM) != 0) | (cps == ord("_"))).astype(jnp.int32),
+        "sh_dot": _shift_r((cps == ord(".")) & mask, False).astype(jnp.int32),
+        "sh_psep": (_shift_r(psep, False) & mask).astype(jnp.int32),
+        "mask": mask.astype(jnp.int32),
+    }
+
+
+def _sentence_passes(fr: dict, begin_extra: jax.Array, nonws: jax.Array, emit: str):
+    """Passes 0-2 of the sentence chain: DFA map composition → sterm run
+    counter → per-segment non-ws counter.  The boundary rule is derived from
+    packed-state taps in-register — the same int32 formulas as
+    :func:`sentence_boundaries` (prev_* via shift taps with fill 0, matching
+    the staged ``_shift_r(..., 0)``; the sterm OR becomes a segmented SUM
+    tested ``> 0``, which agrees bit-for-bit on {0,1} streams)."""
+    from .pallas_scan import Tap, chain_group, chain_pass
+
+    def _prep_hst(pk, pk_prev, t, s):
+        st = pk & 15
+        return (
+            jnp.where((t != 0) & ((pk_prev & 15) != 1), 0, 1),
+            jnp.where(st > 0, s, 0),
+        )
+
+    def _prep_cnt(pk, pk_prev, hst_prev, t, shd, lo, al, shp, mk, ex, nw):
+        st = pk & 15
+        pst = pk_prev & 15
+        fresh = (t != 0) & ((pst == 2) | (pst == 3))
+        cand = (mk != 0) & (pst > 0) & ((st == 0) | fresh)
+        dot_last = (shd != 0) & (pst == 1)
+        nb = ~(hst_prev > 0) & ((dot_last & (al != 0)) | (lo != 0))
+        boundary = (cand & ~nb) | ((shp != 0) & (mk != 0))
+        return jnp.where(boundary | (ex != 0), 0, 1), nw
+
+    return [
+        chain_pass(
+            [{"kind": "dfa", "xs": (fr["fns"],), "emit": emit, "n_states": 4}]
+        ),
+        chain_pass(
+            [
+                chain_group(
+                    "affine",
+                    (Tap(0, 0), Tap(0, 0, shift=1, fill=0), fr["term"], fr["sterm"]),
+                    prep=_prep_hst,
+                    n_ops=2,
+                    emit=emit,
+                )
+            ]
+        ),
+        chain_pass(
+            [
+                chain_group(
+                    "affine",
+                    (
+                        Tap(0, 0),
+                        Tap(0, 0, shift=1, fill=0),
+                        Tap(1, 0, shift=1, fill=0),
+                        fr["term"],
+                        fr["sh_dot"],
+                        fr["lower"],
+                        fr["alnum"],
+                        fr["sh_psep"],
+                        fr["mask"],
+                        begin_extra.astype(jnp.int32),
+                        nonws.astype(jnp.int32),
+                    ),
+                    prep=_prep_cnt,
+                    n_ops=2,
+                    emit="scan" if emit == "scan" else emit,
+                )
+            ]
+        ),
+    ]
+
+
 def sentence_counts(cps: jax.Array, lengths: jax.Array) -> jax.Array:
     """Sentences per row — ``len(split_into_sentences(text))`` for rows whose
     content is already globally trimmed (C4's rewritten batches are)."""
+    from .pallas_scan import Tap, chain_group, chain_pass, chain_scan, chain_scan_ok
+
     _, length = cps.shape
     mask = jnp.arange(length, dtype=jnp.int32)[None, :] < lengths[:, None]
     cls = classify(cps)
     cls = jnp.where(mask, cls, 0).astype(cls.dtype)
+    ws = (cls & WS) != 0
+    nonws = mask & ~ws
+
+    if chain_scan_ok(*cps.shape):
+        # DFA → sterm counter → segment counter → total, ONE dispatch: every
+        # intermediate (three staged dispatches' worth) stays in scratch and
+        # only the [B, 1] sentence count reaches HBM.
+        fr = _sentence_frame(cps, mask, cls)
+        passes = _sentence_passes(fr, _first_col(mask), nonws, emit="none")
+        passes.append(
+            chain_pass(
+                [
+                    chain_group(
+                        "add",
+                        (Tap(2, 0), nonws.astype(jnp.int32)),
+                        prep=lambda c, nw: ((nw != 0) & (c == 1),),
+                        n_ops=1,
+                        emit="last",
+                    )
+                ]
+            )
+        )
+        res = chain_scan(passes)
+        return res[3][0][0][:, 0].astype(jnp.int32)
+
     boundary = sentence_boundaries(cps, mask, cls)
 
     # Count segments containing >= 1 non-ws char.
-    ws = (cls & WS) != 0
-    nonws = mask & ~ws
     seg_begin = boundary | _first_col(mask)
     cnt = seg_scan_add(nonws.astype(jnp.int32), seg_begin)
     first_nonws = nonws & (cnt == 1)
@@ -1246,11 +1797,10 @@ def c4_stage(
     low = _lowered(cps, mask)
     pos = jnp.arange(length, dtype=jnp.int32)[None, :]
 
-    # Doc-level early rejects (c4_filters.rs:166-187).
-    if params.filter_lorem_ipsum:
-        has_lorem = jnp.any(_pattern_union_starts(low, mask, ("lorem ipsum",)), axis=1)
-    else:
-        has_lorem = jnp.zeros(cps.shape[0], dtype=bool)
+    # Doc-level early rejects (c4_filters.rs:166-187).  The lorem-ipsum
+    # candidate prefix hash rides the segmentation chain kernel below when
+    # the chain gate holds (lorem_h), so has_lorem finalizes after the split.
+    lorem_h = None
     has_curly = jnp.any(((cps == ord("{")) | (cps == ord("}"))) & mask, axis=1)
 
     def _citation_deleted(unit_content):
@@ -1276,10 +1826,46 @@ def c4_stage(
         reset = _line_reset(li, mask)
 
         # Per-line trim: chars at/after the first non-ws, at/before the last.
-        from .pallas_scan import fused_scan, fused_scan_ok
+        from .pallas_scan import (
+            chain_pass,
+            chain_scan,
+            chain_scan_ok,
+            fused_scan,
+            fused_scan_ok,
+        )
 
         r_reset = _first_col(mask) | _shift_r(rev(li.is_nl), False)
-        if fused_scan_ok(*cps.shape):
+        if chain_scan_ok(*cps.shape):
+            # Forward line counter (+ the doc-level lorem-ipsum candidate
+            # hash riding along) and the reversed counter as a second pass —
+            # reverse-pass operands are given in natural orientation (the
+            # kernel walks them flipped), i.e. rev() of the staged
+            # reversed-frame operands.
+            g0 = [_seg_add_group((nonws.astype(jnp.int32),), reset)]
+            if params.filter_lorem_ipsum:
+                g0.append(_pattern_hash_group(low, mask))
+            res = chain_scan(
+                [
+                    chain_pass(g0),
+                    chain_pass(
+                        [
+                            {
+                                "kind": "affine",
+                                "xs": (
+                                    rev(jnp.where(r_reset, 0, 1)),
+                                    nonws.astype(jnp.int32),
+                                ),
+                            }
+                        ],
+                        reverse=True,
+                    ),
+                ]
+            )
+            after_first = res[0][0][0] >= 1
+            if params.filter_lorem_ipsum:
+                lorem_h = res[0][1][0]
+            before_last = res[1][0][0] >= 1
+        elif fused_scan_ok(*cps.shape):
             # The forward and reversed line counters are independent — one
             # fused kernel pass instead of two staged scans.
             res = fused_scan(
@@ -1311,11 +1897,41 @@ def c4_stage(
         t1 = jnp.max(jnp.where(nonws_all, pos, -1), axis=1)
         in_trim = (pos >= t0[:, None]) & (pos <= t1[:, None]) & mask
 
-        boundary = sentence_boundaries(cps, in_trim, cls)
-        seg_begin = (boundary | (pos == t0[:, None])) & in_trim
         nonws = in_trim & ~ws
+        from .pallas_scan import chain_scan, chain_scan_ok
 
-        cnt = seg_scan_add(nonws.astype(jnp.int32), seg_begin)
+        if chain_scan_ok(*cps.shape):
+            # Sentence DFA → sterm counter → segment counter in one kernel
+            # (+ the lorem candidate hash riding pass 0); the boundary mask
+            # the compaction handoff needs is recomputed elementwise from
+            # the emitted packed-state/sterm streams — the exact staged
+            # formulas from sentence_boundaries, so bit-identical.
+            fr = _sentence_frame(cps, in_trim, cls)
+            at_t0x = (pos == t0[:, None]) & in_trim
+            passes = _sentence_passes(fr, at_t0x, nonws, emit="scan")
+            if params.filter_lorem_ipsum:
+                passes[0]["groups"].append(_pattern_hash_group(low, mask))
+            res = chain_scan(passes)
+            state = res[0][0][0] & 15
+            if params.filter_lorem_ipsum:
+                lorem_h = res[0][1][0]
+            hst = res[1][0][0]
+            cnt = res[2][0][0]
+            prev_state = _shift_r(state, 0)
+            prev_has_sterm = _shift_r(hst, 0) > 0
+            term = fr["term"] != 0
+            fresh_term = term & ((prev_state == 2) | (prev_state == 3))
+            candidate = in_trim & (prev_state > 0) & ((state == 0) | fresh_term)
+            dot_last = (fr["sh_dot"] != 0) & (prev_state == 1)
+            no_break = ~prev_has_sterm & (
+                (dot_last & (fr["alnum"] != 0)) | (fr["lower"] != 0)
+            )
+            boundary = (candidate & ~no_break) | ((fr["sh_psep"] != 0) & in_trim)
+            seg_begin = (boundary | (pos == t0[:, None])) & in_trim
+        else:
+            boundary = sentence_boundaries(cps, in_trim, cls)
+            seg_begin = (boundary | (pos == t0[:, None])) & in_trim
+            cnt = seg_scan_add(nonws.astype(jnp.int32), seg_begin)
         first_nonws_seg = nonws & (cnt == 1)
         n_units = jnp.sum(first_nonws_seg, axis=1).astype(jnp.int32)
 
@@ -1342,6 +1958,13 @@ def c4_stage(
         c1_src = jnp.where(sep_keep, jnp.int32(NL), cps)
         del any_nonws  # rows without content have empty keep1 already
 
+    if params.filter_lorem_ipsum:
+        has_lorem = jnp.any(
+            _pattern_union_starts(low, mask, ("lorem ipsum",), h_inc=lorem_h), axis=1
+        )
+    else:
+        has_lorem = jnp.zeros(cps.shape[0], dtype=bool)
+
     c1_cps, c1_len = compact(c1_src, keep1, mesh=mesh)
 
     # --- per-line checks on the compacted batch ---
@@ -1353,7 +1976,6 @@ def c4_stage(
     valid_end1 = st1.unit_end & st1.unit_valid
     is_dot1 = (c1_cps == ord(".")) & m1
     dot_start1 = is_dot1 & ~_shift_r(is_dot1, False)
-    dot_run1 = seg_scan_add(is_dot1.astype(jnp.int32), dot_start1)
 
     # Only the UNION of javascript/policy line flags affects line_keep (no
     # per-cause stats are reported), so all patterns share one candidate
@@ -1363,8 +1985,27 @@ def c4_stage(
         line_patterns += ("javascript",)
     if params.filter_policy:
         line_patterns += _POLICY
+
+    from .pallas_scan import chain_pass as _cpass, chain_scan as _cscan
+    from .pallas_scan import chain_scan_ok as _cok
+
+    starts_h = None
+    if _cok(*cps.shape):
+        # Post-compaction pass: the dot-run counter and the line-pattern
+        # candidate hash share one dispatch over the rewritten batch.
+        g1 = [_seg_add_group((is_dot1.astype(jnp.int32),), dot_start1)]
+        if line_patterns:
+            g1.append(_pattern_hash_group(low1, m1))
+        res1 = _cscan([_cpass(g1)])
+        dot_run1 = res1[0][0][0]
+        if line_patterns:
+            starts_h = res1[0][1][0]
+    else:
+        dot_run1 = seg_scan_add(is_dot1.astype(jnp.int32), dot_start1)
     starts = (
-        _pattern_union_starts(low1, m1, line_patterns) if line_patterns else None
+        _pattern_union_starts(low1, m1, line_patterns, h_inc=starts_h)
+        if line_patterns
+        else None
     )
 
     if use_sort_tables():

@@ -822,6 +822,12 @@ def host_allgather(vec: np.ndarray) -> np.ndarray:
     transport = _EXCHANGE.transport
     if transport is None:
         transport = _KV_TRANSPORT
+    # One post per process per call, whatever the vector width — the
+    # counter the batched verdict exchange drives down (a piggybacked
+    # K-flag verdict vector is ONE post where K per-round flags were K).
+    from ..utils.metrics import METRICS
+
+    METRICS.inc("multihost_exchange_posts_total")
     return transport.allgather(arr)
 
 
@@ -1331,6 +1337,98 @@ def run_local_shard(
                             time.perf_counter() - t0,
                         )
 
+                def resolve_batch(n):
+                    """Drain the ``n`` oldest in-flight rounds under ONE
+                    batched verdict post (``NegotiatedGuard.
+                    negotiate_batch``): every round's local flag is fetched
+                    first, then all flags ride a single allgather vector
+                    instead of one scalar post each.  ``n`` is derived from
+                    the negotiated plan and depth, so every host batches
+                    the identical rounds.  With no guard or a single round
+                    this IS ``resolve_front`` — depth-1 behavior stays
+                    byte-identical by construction.  On the first joint
+                    fault the younger rounds' piggybacked flags are void
+                    (measured on launched-ahead state the drain discards):
+                    they return to the window, the faulted round re-enters
+                    the serial retry protocol with its verdict pre-resolved
+                    (``prior_fault``), and the remainder resolves
+                    round-at-a-time — the exact drain ordering of the
+                    unbatched path."""
+                    if guard is None or n <= 1:
+                        for _ in range(n):
+                            resolve_front()
+                        return
+                    entries = [window.popleft() for _ in range(n)]
+                    TRACER.counter("lockstep_window", len(window))
+                    t0 = time.perf_counter()
+                    faults, stats_list = [], []
+                    for entry in entries:
+                        fault, st = bool(entry["fault"]), None
+                        if not fault:
+                            try:
+                                st = _local_stats(entry["out"])
+                            except BaseException as e:  # noqa: BLE001
+                                if classify_error(e) != "retryable":
+                                    raise
+                                fault = True
+                        faults.append(fault)
+                        stats_list.append(st)
+                    verdicts = guard.negotiate_batch(faults)
+                    METRICS.inc(
+                        "multihost_window_stall_seconds_total",
+                        time.perf_counter() - t0,
+                    )
+                    for i, entry in enumerate(entries):
+                        local, ph, eb = (
+                            entry["batch"], entry["phase"], entry["bucket"]
+                        )
+                        if verdicts[i]:
+                            # Younger rounds rejoin the window BEFORE the
+                            # drain hook fires, so the joint drain clears
+                            # exactly the launched-ahead set the unbatched
+                            # path would have cleared.
+                            for e in reversed(entries[i + 1:]):
+                                window.appendleft(e)
+                            TRACER.counter("lockstep_window", len(window))
+                            with TRACER.span(
+                                "lockstep_resolve",
+                                {"bucket": eb, "phase": ph},
+                            ):
+                                stats = guard.run_round(
+                                    eb,
+                                    dispatch=lambda local=local, ph=ph: (
+                                        pipeline.dispatch_lockstep(
+                                            local, ph, sh2, sh1
+                                        )
+                                    ),
+                                    fetch=_local_stats,
+                                    on_fault=drain_window,
+                                    prior_fault=True,
+                                    prior_local_fault=faults[i],
+                                )
+                                if stats is None:
+                                    degraded.extend(local.docs)
+                                else:
+                                    po, alive = pipeline.assemble_phase(
+                                        local, stats, ph
+                                    )
+                                    outcomes.extend(po)
+                                    absorb(eb, alive)
+                                consumed[entry["plan_idx"]] = True
+                            while window:
+                                resolve_front()
+                            return
+                        with TRACER.span(
+                            "lockstep_resolve", {"bucket": eb, "phase": ph}
+                        ):
+                            guard.record_round_success(eb)
+                            po, alive = pipeline.assemble_phase(
+                                local, stats_list[i], ph
+                            )
+                            outcomes.extend(po)
+                            absorb(eb, alive)
+                            consumed[entry["plan_idx"]] = True
+
                 for j, (b, r, chunk) in enumerate(plan):
                     if guard is not None and guard.bucket_degraded(b):
                         # Breaker latched on negotiated verdicts, so every
@@ -1367,8 +1465,7 @@ def run_local_shard(
                     TRACER.counter("lockstep_window", len(window))
                     while len(window) > depth:
                         resolve_front()
-                while window:
-                    resolve_front()
+                resolve_batch(len(window))
                 break
             except GangReformed:
                 # Resume at the next round boundary over the survivor set:

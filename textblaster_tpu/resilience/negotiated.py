@@ -110,10 +110,34 @@ class NegotiatedGuard:
         host_allgather` transport the round schedule is negotiated with —
         one int per host per call (XLA allgather on accelerators, the
         coordination-service KV store on multi-process CPU)."""
+        return self.negotiate_batch([local_fault])[0]
+
+    def negotiate_batch(self, local_faults: Sequence[bool]) -> list:
+        """ONE verdict post carrying the fault flag of EVERY round the
+        caller resolved since the last exchange; returns the per-round
+        joint verdicts in the same order.
+
+        The window drain in ``run_local_shard`` resolves its in-flight
+        rounds in a burst; posting their flags as one vector collapses
+        ``len(local_faults)`` transport posts into a single one.  A
+        1-element batch posts the identical ``[0|1]`` vector the classic
+        per-round :meth:`_negotiate` posted, so depth-1 traffic is
+        byte-identical on the wire.  Callers must walk the verdicts in
+        order and treat the FIRST fault as authoritative: the flags of the
+        rounds behind it were measured on launched-ahead state the joint
+        drain is about to discard, so every host voids them identically
+        and re-negotiates those rounds at their own (post-drain) resolve."""
         from ..parallel.multihost import host_allgather
 
-        flags = host_allgather(np.array([1 if local_fault else 0]))
-        return bool(flags.max() > 0)
+        flags = host_allgather(
+            np.array([1 if f else 0 for f in local_faults])
+        )
+        if len(local_faults) > 1:
+            METRICS.inc(
+                "resilience_negotiated_batched_verdicts_total",
+                len(local_faults),
+            )
+        return [bool(v) for v in (flags.max(axis=0) > 0)]
 
     @staticmethod
     def _epoch() -> int:
@@ -133,6 +157,15 @@ class NegotiatedGuard:
         b = self.breakers.get(bucket)
         return b is not None and b.tripped
 
+    def record_round_success(self, bucket: int) -> None:
+        """Book a round whose joint verdict arrived via
+        :meth:`negotiate_batch` as a success — the same metrics/breaker
+        transition the clean-verdict exit of :meth:`run_round` performs,
+        so the breaker's verdict sequence is identical whether a round's
+        flag traveled alone or piggybacked in a batch."""
+        METRICS.inc("resilience_negotiated_rounds_total")
+        self.breakers[bucket].record_success()
+
     # --- the guarded round --------------------------------------------------
 
     def run_round(
@@ -143,6 +176,8 @@ class NegotiatedGuard:
         inflight: Optional[object] = None,
         launch_fault: bool = False,
         on_fault: Optional[Callable[[], None]] = None,
+        prior_fault: bool = False,
+        prior_local_fault: bool = False,
     ):
         """Resolve one lockstep round under the negotiated protocol.
 
@@ -159,6 +194,12 @@ class NegotiatedGuard:
         host's global program order after the verdict is the same
         ``[retry(r), r+1, r+2, ...]`` sequence.  The verdict is allgathered,
         so every host invokes its hook at the identical point.
+
+        ``prior_fault`` marks that this round's FIRST joint verdict was
+        already exchanged (fault) via :meth:`negotiate_batch` — the loop
+        enters the fault branch directly instead of re-posting it, with
+        ``prior_local_fault`` preserving this host's own flag for the
+        verdict trace.  Every later attempt negotiates per-round as usual.
 
         Returns the fetched stats, or ``None`` when all hosts jointly
         degraded the round to the host oracle.  Fatal (deterministic)
@@ -179,34 +220,46 @@ class NegotiatedGuard:
 
         METRICS.inc("resilience_negotiated_rounds_total")
         attempt = 0
+        pre_verdict = bool(prior_fault)
         while True:
-            local_fault = bool(launch_fault)
-            stats = None
-            if not local_fault:
+            if pre_verdict:
+                # The batched window exchange already posted this round's
+                # first flag and delivered a joint fault — fall through to
+                # the fault branch without a second post for the same
+                # verdict.
+                pre_verdict = False
+                local_fault, stats = bool(prior_local_fault), None
+                inflight, launch_fault = None, False
+                any_fault = True
+            else:
+                local_fault = bool(launch_fault)
+                stats = None
+                if not local_fault:
+                    try:
+                        out = inflight if inflight is not None else dispatch()
+                        stats = fetch(out)
+                    except BaseException as e:  # noqa: BLE001 — classifier decides
+                        if classify_error(e) != "retryable":
+                            raise
+                        logger.warning(
+                            "Lockstep round (bucket %s) faulted locally on "
+                            "attempt %d: %s",
+                            bucket, attempt + 1, e,
+                        )
+                        local_fault = True
+                # Past the first attempt nothing is in flight: a negotiated
+                # retry must re-dispatch on EVERY host, succeeded ones
+                # included.
+                inflight, launch_fault = None, False
                 try:
-                    out = inflight if inflight is not None else dispatch()
-                    stats = fetch(out)
-                except BaseException as e:  # noqa: BLE001 — classifier decides
-                    if classify_error(e) != "retryable":
-                        raise
-                    logger.warning(
-                        "Lockstep round (bucket %s) faulted locally on "
-                        "attempt %d: %s",
-                        bucket, attempt + 1, e,
+                    any_fault = self._negotiate(local_fault)
+                except GangReformed:
+                    TRACER.instant(
+                        "negotiated_reformed",
+                        {"bucket": bucket, "attempt": attempt,
+                         "epoch": self._epoch()},
                     )
-                    local_fault = True
-            # Past the first attempt nothing is in flight: a negotiated
-            # retry must re-dispatch on EVERY host, succeeded ones included.
-            inflight, launch_fault = None, False
-            try:
-                any_fault = self._negotiate(local_fault)
-            except GangReformed:
-                TRACER.instant(
-                    "negotiated_reformed",
-                    {"bucket": bucket, "attempt": attempt,
-                     "epoch": self._epoch()},
-                )
-                raise
+                    raise
             if not any_fault:
                 self.breakers[bucket].record_success()
                 return stats

@@ -133,6 +133,7 @@ _TRACE_ENV_KNOBS = (
     "TEXTBLAST_NO_PALLAS",
     "TEXTBLAST_PALLAS_INTERPRET",
     "TEXTBLAST_FUSED",
+    "TEXTBLAST_DEPFUSE",
 )
 
 

@@ -176,6 +176,12 @@ _SPECS: Dict[str, Tuple[str, str]] = {
         "Lockstep rounds jointly degraded to the host oracle (retry budget "
         "exhausted or bucket breaker latched)",
     ),
+    "resilience_negotiated_batched_verdicts_total": (
+        "counter",
+        "Round fault flags that traveled piggybacked in a batched verdict "
+        "vector (one allgather post for the whole window drain) instead of "
+        "posting one scalar exchange each",
+    ),
     "multihost_merge_commits_total": (
         "counter",
         "Final output files committed atomically (tmp+fsync+rename) by the "
@@ -258,6 +264,13 @@ _SPECS: Dict[str, Tuple[str, str]] = {
         "counter",
         "Exchange slot files posted by the file-lease transport "
         "(--exchange-transport file), one per rank per collective",
+    ),
+    "multihost_exchange_posts_total": (
+        "counter",
+        "host_allgather collectives this process posted a row into, any "
+        "transport and any vector width — the batched verdict exchange "
+        "drives this down by piggybacking a window's fault flags into one "
+        "vector post",
     ),
     # Overlapped-pipeline stage accounting (no reference equivalent).  The
     # counters are wall seconds spent *inside* each stage, summed across
