@@ -1458,6 +1458,71 @@ pipeline:
             f"{trace_events} events)"
         )
 
+    # --- Doc-sampling telemetry overhead, A/B (BENCH_TELEMETRY=0 skips).
+    # Both arms run the full pipeline INCLUDING the Parquet write seam
+    # (aggregate_results_from_stream into temp files) — lineages only close
+    # at the write, so a device-only pass would measure the marks but never
+    # the completion path.  Off must be free (one attribute check per seam);
+    # on is 1-in-BENCH_DOC_SAMPLE docs paying a crc32 + dict stamp per stage.
+    telemetry_report = None
+    if os.environ.get("BENCH_TELEMETRY", "1") != "0":
+        import shutil
+        import tempfile
+
+        from textblaster_tpu.orchestration import aggregate_results_from_stream
+        from textblaster_tpu.utils.metrics import latency_report
+        from textblaster_tpu.utils.telemetry import TELEMETRY
+
+        sample_rate = int(os.environ.get("BENCH_DOC_SAMPLE", "8"))
+        telem_tmp = tempfile.mkdtemp(prefix="bench_telem_")
+
+        def _telem_pass(tag: str) -> float:
+            run = [d.copy() for d in docs]
+            t0 = time.perf_counter()
+            aggregate_results_from_stream(
+                process_documents_device(config, iter(run), pipeline=pipeline),
+                output_file=os.path.join(telem_tmp, f"{tag}_out.parquet"),
+                excluded_file=os.path.join(telem_tmp, f"{tag}_exc.parquet"),
+            )
+            return time.perf_counter() - t0
+
+        try:
+            telem_off_s = [_telem_pass(f"off{i}") for i in range(2)]
+            telem_base = metrics_snapshot()
+            sampled_before = METRICS.get("doc_sampled_total")
+            TELEMETRY.configure(sample_rate, start_ticker=False)
+            telem_on_s = []
+            for i in range(2):
+                telem_on_s.append(_telem_pass(f"on{i}"))
+                TELEMETRY.roll_window()  # deterministic window per pass
+            telem_latency = latency_report(telem_base)
+            telem_windows = TELEMETRY.snapshot()["windows"]
+            telem_off_rate = len(docs) / min(telem_off_s)
+            telem_on_rate = len(docs) / min(telem_on_s)
+            telemetry_report = {
+                "doc_sample_rate": sample_rate,
+                "telemetry_on_docs_per_sec": round(telem_on_rate, 2),
+                "telemetry_off_docs_per_sec": round(telem_off_rate, 2),
+                "overhead_frac": round(1.0 - telem_on_rate / telem_off_rate, 4),
+                "sampled_docs": int(
+                    METRICS.get("doc_sampled_total") - sampled_before
+                ),
+                "latency": telem_latency["stages"],
+                "last_window": telem_windows[-1] if telem_windows else None,
+            }
+            _log(
+                f"telemetry: {telem_on_rate:.1f} docs/s sampled 1-in-"
+                f"{sample_rate} vs {telem_off_rate:.1f} off "
+                f"(overhead {telemetry_report['overhead_frac']:+.2%}, "
+                f"{telemetry_report['sampled_docs']} docs sampled)"
+            )
+        except Exception as e:  # never bill a telemetry problem to the bench
+            telemetry_report = {"error": f"{type(e).__name__}: {e}"[:500]}
+            _log(f"telemetry A/B skipped: {e}")
+        finally:
+            TELEMETRY.close()
+            shutil.rmtree(telem_tmp, ignore_errors=True)
+
     # Noise self-diagnosis: spreads over the raw passes plus the load
     # averages bracketing each side.  The bench's own process keeps a 1-core
     # box at load ~1; sustained load beyond ~1.8 means a foreign process was
@@ -1571,6 +1636,10 @@ pipeline:
         # Trace on/off A/B over the device path: the span tracer must stay
         # within ~2% of the untraced rate when on and free when off.
         **({"trace": trace_report} if trace_report else {}),
+        # Doc-sampling telemetry on/off A/B through the full write path:
+        # per-stage tail quantiles for the sampled docs plus the overhead
+        # the 1-in-N sampler costs (off must be free, on low single digits).
+        **({"telemetry": telemetry_report} if telemetry_report else {}),
         # The merged observability report for the 3 timed passes — same
         # schema as `--run-report` (stages, occupancy, resilience, funnel).
         "run_report": run_report,

@@ -39,6 +39,12 @@ def _populated_registry() -> Metrics:
     m.inc(OCCUPANCY_BUCKET_PREFIX + "2048", 1)
     m.inc(FILTER_DROP_PREFIX + "GopherQualityFilter", 9)
     m.inc(FILTER_DROP_PREFIX + "C4QualityFilter", 2)
+    # HDR families: sub-bucket-exact, mid-range, and far-tail observations so
+    # the rendered buckets span all three index regimes.
+    for us in (3, 900, 45_000, 2_000_000, 45_000_000):
+        m.observe_hdr("doc_latency_e2e_seconds", us)
+    m.observe_hdr("doc_latency_write_seconds", 1_200)
+    m.observe_hdr("exchange_post_latency_seconds", 850)
     return m
 
 
@@ -111,6 +117,36 @@ def test_exposition_lints_clean():
         sum_rows = [s for s in rows if s[1] == family + "_sum"]
         assert len(count_rows) == 1 and len(sum_rows) == 1
         assert float(count_rows[0][3].rsplit(" ", 1)[1]) == counts[-1]
+
+
+def test_hdr_families_expose_full_histogram_shape():
+    """The sampled-latency HDR families render as first-class Prometheus
+    histograms: announced HELP/TYPE, strictly ascending ``le`` bounds, a
+    terminal ``+Inf`` bucket, and matching ``_sum``/``_count`` series."""
+    text = _populated_registry().render()
+    for family in (
+        "doc_latency_e2e_seconds",
+        "doc_latency_write_seconds",
+        "exchange_post_latency_seconds",
+    ):
+        assert f"# TYPE {family} histogram" in text, family
+        bucket_lines = [
+            line
+            for line in text.splitlines()
+            if line.startswith(family + "_bucket{")
+        ]
+        assert bucket_lines, f"{family} rendered no buckets"
+        les = []
+        for line in bucket_lines:
+            m = re.search(r'le="([^"]+)"', line)
+            assert m, line
+            les.append(float("inf") if m.group(1) == "+Inf" else float(m.group(1)))
+        assert les[-1] == float("inf"), f"{family} missing +Inf bucket"
+        assert all(a < b for a, b in zip(les, les[1:])), (
+            f"{family} le bounds not strictly ascending"
+        )
+        assert any(line.startswith(family + "_sum ") for line in text.splitlines())
+        assert any(line.startswith(family + "_count ") for line in text.splitlines())
 
 
 def test_every_sample_name_is_legal():

@@ -52,6 +52,10 @@ def test_build_run_report_from_summed_values():
         "occupancy_real_codepoints_total": 600,
         "resilience_retries_total": 2,
         FILTER_DROP_PREFIX + "GopherQualityFilter": 5,
+        # Encoded HDR keys (v2): bucket 10 = 10 µs exactly (sub-32 regime).
+        "doc_latency_e2e_seconds::h10": 3,
+        "doc_latency_e2e_seconds::sum": 30,
+        "doc_latency_e2e_seconds::count": 3,
     }
     host_b = {
         "stage_read_seconds": 2.0,
@@ -61,6 +65,9 @@ def test_build_run_report_from_summed_values():
         "occupancy_real_codepoints_total": 400,
         FILTER_DROP_PREFIX + "GopherQualityFilter": 3,
         FILTER_DROP_PREFIX + "C4QualityFilter": 1,
+        "doc_latency_e2e_seconds::h10": 1,
+        "doc_latency_e2e_seconds::sum": 10,
+        "doc_latency_e2e_seconds::count": 1,
     }
     summed = dict(host_a)
     for k, v in host_b.items():
@@ -86,6 +93,10 @@ def test_build_run_report_from_summed_values():
         "C4QualityFilter": 1,
     }
     assert report["funnel"]["dropped_total"] == 9
+    # v2: the summed encoded keys decode into gang-wide quantiles.
+    e2e = report["latency"]["stages"]["e2e"]
+    assert e2e["count"] == 4
+    assert e2e["p50_s"] == e2e["p99_s"] == 10 / 1e6
 
 
 def _free_port() -> int:

@@ -1,0 +1,399 @@
+"""Per-document tail-latency telemetry: HDR quantile error bounds,
+merge algebra, the deterministic doc sampler, snapshot encoding, lineage
+plumbing, rollup windows, and the trace-drop accounting satellite.
+
+The HDR property tests are the load-bearing ones: the multi-host merged
+run report is only trustworthy if (a) every quantile read off the bucket
+scheme is within the advertised relative error of the exact sample
+quantile, and (b) bucket-wise merge is exact — merging per-host
+histograms must equal histogramming the concatenated samples.
+"""
+
+import math
+import os
+import types
+
+import numpy as np
+import pytest
+
+from textblaster_tpu.utils import telemetry as telemetry_mod
+from textblaster_tpu.utils.metrics import (
+    DOC_LATENCY_STAGES,
+    HDR_RELATIVE_ERROR,
+    METRICS,
+    Metrics,
+    RUN_REPORT_SCHEMA,
+    _SPECS,
+    build_run_report,
+    hdr_bucket_high_us,
+    hdr_bucket_index,
+    hdr_quantile_us,
+    latency_report,
+)
+from textblaster_tpu.utils.telemetry import (
+    TELEMETRY,
+    LogLinearHistogram,
+    doc_sampled,
+    expected_waste,
+    format_latency_summary,
+)
+from textblaster_tpu.utils.trace import TRACER, Tracer
+
+pytestmark = pytest.mark.telemetry
+
+QUANTILES = (0.5, 0.9, 0.95, 0.99, 1.0)
+
+
+def _exact_quantile(values, q):
+    """The rank-based exact quantile hdr_quantile_us targets: the value at
+    rank max(1, ceil(q*n)) of the sorted sample."""
+    s = sorted(values)
+    rank = max(1, math.ceil(q * len(s)))
+    return s[rank - 1]
+
+
+def _adversarial_distributions():
+    rng = np.random.default_rng(20260806)
+    out = {
+        # Two far-apart modes: quantiles sit on cliff edges between modes.
+        "bimodal": np.concatenate(
+            [
+                rng.integers(10, 100, size=4000),
+                rng.integers(1_000_000, 5_000_000, size=1000),
+            ]
+        ),
+        # Pareto tail: the p99 is orders of magnitude above the median.
+        "heavy_tail": (rng.pareto(1.5, size=5000) * 1_000).astype(np.int64) + 1,
+        # Degenerate: every observation identical.
+        "single_value": np.full(777, 123_456, dtype=np.int64),
+        # Sub-bucket regime: values < 32 µs are represented exactly.
+        "tiny_exact": rng.integers(0, 32, size=2000),
+        # Log-uniform sweep across ~9 decades.
+        "log_uniform": np.exp(rng.uniform(0, 21, size=5000)).astype(np.int64),
+    }
+    return {k: [int(v) for v in vals] for k, vals in out.items()}
+
+
+# --------------------------------------------------------------------------
+# HDR bucket scheme + quantile error bound (satellite c, part 1)
+
+
+def test_hdr_bucket_scheme_monotone_and_bounded():
+    prev_high = -1
+    for idx in range(640):
+        high = hdr_bucket_high_us(idx)
+        assert high > prev_high, f"bucket highs not strictly increasing at {idx}"
+        prev_high = high
+        assert hdr_bucket_index(high) == idx, f"high of bucket {idx} maps back"
+
+
+@pytest.mark.parametrize("dist", sorted(_adversarial_distributions()))
+def test_hdr_quantiles_within_relative_error(dist):
+    values = _adversarial_distributions()[dist]
+    h = LogLinearHistogram()
+    for v in values:
+        h.record(v)
+    assert h.count == len(values)
+    assert h.sum_us == sum(values)
+    for q in QUANTILES:
+        exact = _exact_quantile(values, q)
+        got = h.quantile_us(q)
+        # The bucket scheme rounds UP to the bucket's inclusive high, never
+        # past (1 + 1/M) of the true value; values < 32 µs are exact.
+        assert exact <= got, f"{dist} q={q}: {got} < exact {exact}"
+        assert got <= exact * (1 + HDR_RELATIVE_ERROR) + 1, (
+            f"{dist} q={q}: {got} beyond error bound of {exact}"
+        )
+        if exact < 32:
+            assert got == exact
+
+
+def test_hdr_quantiles_match_exact_numpy_on_tiny_values():
+    values = list(range(32)) * 3
+    h = LogLinearHistogram()
+    for v in values:
+        h.record(v)
+    for q in QUANTILES:
+        assert h.quantile_us(q) == _exact_quantile(values, q)
+        # And agrees with numpy's inverted-CDF (type-1) quantile.
+        assert h.quantile_us(q) == int(
+            np.quantile(np.array(values), q, method="inverted_cdf")
+        )
+
+
+# --------------------------------------------------------------------------
+# Merge algebra (satellite c, part 2)
+
+
+def test_hdr_merge_commutative_associative_and_exact():
+    dists = _adversarial_distributions()
+    a_vals, b_vals, c_vals = (
+        dists["bimodal"],
+        dists["heavy_tail"],
+        dists["log_uniform"],
+    )
+    a, b, c = LogLinearHistogram(), LogLinearHistogram(), LogLinearHistogram()
+    for h, vals in ((a, a_vals), (b, b_vals), (c, c_vals)):
+        for v in vals:
+            h.record(v)
+
+    ab, ba = a.merge(b), b.merge(a)
+    assert ab.to_dict() == ba.to_dict(), "merge is not commutative"
+    assert a.merge(b.merge(c)).to_dict() == ab.merge(c).to_dict(), (
+        "merge is not associative"
+    )
+
+    # Merged histogram == histogram of the concatenated samples, exactly —
+    # the property that makes the multi-host sum-merge a lossless exchange.
+    concat = LogLinearHistogram()
+    for v in a_vals + b_vals:
+        concat.record(v)
+    assert ab.to_dict() == concat.to_dict()
+    for q in QUANTILES:
+        assert ab.quantile_us(q) == concat.quantile_us(q)
+        exact = _exact_quantile(a_vals + b_vals, q)
+        assert exact <= ab.quantile_us(q) <= exact * (1 + HDR_RELATIVE_ERROR) + 1
+
+    # Round-trips through the JSON form without loss.
+    assert LogLinearHistogram.from_dict(ab.to_dict()).to_dict() == ab.to_dict()
+
+
+# --------------------------------------------------------------------------
+# Deterministic sampler
+
+
+def test_doc_sampler_deterministic_and_stripe_independent():
+    ids = [f"doc-{i:06d}" for i in range(5000)]
+    sampled = {d for d in ids if doc_sampled(d, 8)}
+    # Deterministic: same answer on every call (crc32, not salted hash()).
+    assert sampled == {d for d in ids if doc_sampled(d, 8)}
+    # Roughly 1-in-8 (crc32 is uniform enough for a 4x tolerance band).
+    assert len(ids) / 32 < len(sampled) < len(ids) / 2
+    # Stripe independence: any partition of the population samples exactly
+    # the per-id answer — hosts never disagree about a document.
+    stripe0, stripe1 = ids[0::2], ids[1::2]
+    assert sampled == {d for d in stripe0 if doc_sampled(d, 8)} | {
+        d for d in stripe1 if doc_sampled(d, 8)
+    }
+    assert not any(doc_sampled(d, 0) for d in ids[:100])
+    assert all(doc_sampled(d, 1) for d in ids[:100])
+
+
+# --------------------------------------------------------------------------
+# Snapshot encoding + multi-host style merge (satellite a)
+
+
+def _merge_like_multihost(snapshots):
+    """The exact rule run_multihost applies to allgathered snapshots:
+    gauges take max, everything else (counters + encoded histogram keys,
+    which are absent from _SPECS and default to counter) sums."""
+    merged = {}
+    for snap in snapshots:
+        for k, v in snap.items():
+            if _SPECS.get(k, ("counter",))[0] == "gauge":
+                merged[k] = max(merged.get(k, 0.0), v)
+            else:
+                merged[k] = merged.get(k, 0.0) + v
+    return merged
+
+
+def test_all_values_encodes_histograms_and_merges_bucketwise():
+    host0, host1, combined = Metrics(), Metrics(), Metrics()
+    rng = np.random.default_rng(7)
+    for m_host in (host0, host1):
+        for _ in range(500):
+            us = int(rng.integers(1, 10_000_000))
+            m_host.observe_hdr("doc_latency_e2e_seconds", us)
+            combined.observe_hdr("doc_latency_e2e_seconds", us)
+        m_host.observe("worker_task_processing_duration_seconds", 0.25)
+        combined.observe("worker_task_processing_duration_seconds", 0.25)
+
+    snap = host0.all_values()
+    assert any(k.startswith("doc_latency_e2e_seconds::h") for k in snap)
+    assert snap["doc_latency_e2e_seconds::count"] == 500
+    assert any(
+        k.startswith("worker_task_processing_duration_seconds::b") for k in snap
+    )
+    assert snap["worker_task_processing_duration_seconds::count"] == 1
+
+    merged = _merge_like_multihost([host0.all_values(), host1.all_values()])
+    expected = combined.all_values()
+    for k, v in expected.items():
+        if "::" in k or _SPECS.get(k, ("counter",))[0] != "gauge":
+            assert merged.get(k, 0.0) == pytest.approx(v), k
+    # The decoded quantile block off the merged snapshot equals the block
+    # a single registry holding all observations produces — deterministic
+    # gang-wide percentiles with no histogram-specific exchange.
+    assert latency_report(values=merged) == latency_report(values=expected)
+
+
+def test_latency_report_reads_deltas_against_baseline():
+    m = Metrics()
+    m.observe_hdr("doc_latency_write_seconds", 100)
+    base = m.all_values()
+    for us in (200, 300, 400):
+        m.observe_hdr("doc_latency_write_seconds", us)
+    rep = latency_report(baseline=base, values=m.all_values())
+    assert rep["relative_error"] == HDR_RELATIVE_ERROR
+    assert rep["stages"]["write"]["count"] == 3  # baseline obs excluded
+    assert rep["stages"]["write"]["p50_s"] >= 200 / 1e6
+
+
+def test_run_report_v2_has_latency_and_histogram_sections():
+    assert RUN_REPORT_SCHEMA == "textblaster-run-report/v2"
+    m = Metrics()
+    m.observe_hdr("doc_latency_e2e_seconds", 5000)
+    m.observe("worker_task_processing_duration_seconds", 0.01)
+    report = build_run_report(baseline={}, values=m.all_values(), wall_time_s=1.0)
+    assert report["schema"] == RUN_REPORT_SCHEMA
+    assert report["latency"]["stages"]["e2e"]["count"] == 1
+    hists = report["histograms"]
+    fam = hists["worker_task_processing_duration_seconds"]
+    assert fam["count"] == 1
+    assert sum(fam["buckets"].values()) == 1  # non-cumulative per-bucket counts
+
+
+# --------------------------------------------------------------------------
+# Lineage plumbing
+
+
+@pytest.fixture
+def telem():
+    TELEMETRY.configure(1, start_ticker=False)
+    try:
+        yield TELEMETRY
+    finally:
+        TELEMETRY.close()
+
+
+def test_lineage_end_to_end_stage_deltas(telem):
+    base = METRICS.all_values()
+    docs = [types.SimpleNamespace(id=f"d{i}") for i in range(20)]
+    ids = [d.id for d in docs]
+    for stage in ("read", "pack", "dispatch", "device_wait", "assemble", "write"):
+        telem.mark(stage, ids)
+    telem.complete(docs)
+    rep = latency_report(baseline=base)
+    for stage in DOC_LATENCY_STAGES:
+        assert rep["stages"][stage]["count"] == 20, stage
+    assert telem.snapshot()["open_lineages"] == 0
+    summary = format_latency_summary(base)
+    assert "Per-document tail latency" in summary
+    assert "e2e" in summary
+
+
+def test_mark_is_first_seen_and_skips_unsampled(telem):
+    base = METRICS.all_values()
+    telem.mark("read", ["x1"])
+    telem.mark("read", ["x1"])  # re-mark must not move the stamp
+    with telem._lock:
+        first = telem._lineage["x1"]["read"]
+    telem.mark("read", ["x1"])
+    with telem._lock:
+        assert telem._lineage["x1"]["read"] == first
+    telem.complete([types.SimpleNamespace(id="x1")])
+    # A doc never marked contributes nothing.
+    telem.complete([types.SimpleNamespace(id="never-seen")])
+    rep = latency_report(baseline=base)
+    assert rep["stages"]["e2e"]["count"] == 1
+
+
+def test_lineage_eviction_at_cap(telem, monkeypatch):
+    monkeypatch.setattr(telemetry_mod, "_LINEAGE_CAP", 4)
+    evicted_before = METRICS.get("doc_lineage_evicted_total")
+    telem.mark("read", [f"cap{i}" for i in range(10)])
+    assert telem.snapshot()["open_lineages"] == 4
+    assert METRICS.get("doc_lineage_evicted_total") - evicted_before == 6
+
+
+def test_disabled_telemetry_is_inert():
+    TELEMETRY.close()
+    assert not TELEMETRY.enabled
+    sampled_before = METRICS.get("doc_sampled_total")
+    TELEMETRY.mark("read", ["ghost"])
+    TELEMETRY.complete([types.SimpleNamespace(id="ghost")])
+    assert METRICS.get("doc_sampled_total") == sampled_before
+    assert TELEMETRY.snapshot()["open_lineages"] == 0
+
+
+# --------------------------------------------------------------------------
+# Rollup windows + geometry drift
+
+
+def test_roll_window_rates_and_drift_detector():
+    TELEMETRY.configure(4, start_ticker=False, window_s=2.0, drift_threshold=0.1)
+    try:
+        TELEMETRY.set_geometry_baseline(0.10)
+        METRICS.inc("producer_results_received_total", 500)
+        METRICS.inc("occupancy_padded_lanes_total", 1000)
+        METRICS.inc("occupancy_real_codepoints_total", 500)  # waste 0.5
+        TRACER.configure(None)  # in-memory ring, to observe the instant
+        try:
+            window = TELEMETRY.roll_window()
+            events = TRACER.drain()
+        finally:
+            TRACER.close()
+        assert window["docs_per_s"] == pytest.approx(250.0)
+        assert window["waste_ratio"] == pytest.approx(0.5)
+        assert window["geometry_drift"] == pytest.approx(0.4)
+        assert METRICS.get("geometry_drift") == pytest.approx(0.4)
+        assert any(e.get("name") == "geometry_drift" for e in events)
+
+        # Second window with no new counters: rates go to zero, waste is
+        # None (no lanes), drift gauge unchanged, NO second edge instant.
+        TRACER.configure(None)
+        try:
+            w2 = TELEMETRY.roll_window()
+            events2 = TRACER.drain()
+        finally:
+            TRACER.close()
+        assert w2["docs_per_s"] == 0.0
+        assert w2["waste_ratio"] is None
+        assert not any(e.get("name") == "geometry_drift" for e in events2)
+
+        snap = TELEMETRY.snapshot()
+        assert len(snap["windows"]) == 2
+        assert snap["baseline_waste_ratio"] == pytest.approx(0.10)
+    finally:
+        TELEMETRY.close()
+
+
+def test_expected_waste_is_deterministic():
+    geom = types.SimpleNamespace(buckets=(128, 512, 2048))
+    lengths = [64, 100, 400, 2000, 9999]  # 9999 overflows -> clamps to 2048
+    w = expected_waste(lengths, geom)
+    assert w == expected_waste(list(lengths), geom)
+    lanes = 128 + 128 + 512 + 2048 + 2048
+    real = 64 + 100 + 400 + 2000 + 2048
+    assert w == round(1.0 - real / lanes, 6)
+    assert expected_waste([], geom) == 0.0
+
+
+# --------------------------------------------------------------------------
+# Trace-drop accounting (satellite b)
+
+
+@pytest.mark.skipif(not os.path.exists("/dev/full"), reason="needs /dev/full")
+def test_trace_spill_failure_counts_drops_and_warns(capsys):
+    dropped_before = METRICS.get("trace_events_dropped_total")
+    t = Tracer()
+    t.configure("/dev/full")  # open succeeds; write/flush raise ENOSPC
+    for i in range(50):
+        t.instant("ev", {"i": i})
+    t.close()  # spill fails here; close must survive and null the handle
+    assert t._fh is None
+    dropped = METRICS.get("trace_events_dropped_total") - dropped_before
+    assert dropped >= 50
+    err = capsys.readouterr().err
+    assert "trace events dropped" in err
+
+
+def test_trace_ring_overflow_counts_drops(capsys):
+    dropped_before = METRICS.get("trace_events_dropped_total")
+    t = Tracer()
+    t.configure(None, ring=16)  # in-memory mode drops oldest half at cap
+    for i in range(100):
+        t.instant("ev", {"i": i})
+    t.close()
+    assert METRICS.get("trace_events_dropped_total") > dropped_before
+    assert "trace events dropped" in capsys.readouterr().err

@@ -29,6 +29,7 @@ from textblaster_tpu.config.pipeline import parse_pipeline_config
 from textblaster_tpu.data_model import TextDocument
 from textblaster_tpu.ops.pipeline import process_documents_device
 from textblaster_tpu.resilience import FAULTS
+from textblaster_tpu.utils.metrics import RUN_REPORT_SCHEMA
 from textblaster_tpu.utils.trace import TRACER
 
 CONFIG_YAML = """
@@ -215,7 +216,7 @@ def test_cli_trace_and_run_report_end_to_end(tmp_path, capsys):
 
     # The run report's funnel sums exactly to the excluded row count.
     report = json.loads(report_path.read_text(encoding="utf-8"))
-    assert report["schema"] == "textblaster-run-report/v1"
+    assert report["schema"] == RUN_REPORT_SCHEMA
     excluded_rows = pq.read_table(str(exc)).num_rows
     assert report["funnel"]["dropped_total"] == excluded_rows
     assert (

@@ -33,6 +33,7 @@ from .utils.metrics import (
     setup_prometheus_metrics,
     write_run_report,
 )
+from .utils.telemetry import TELEMETRY, format_latency_summary
 from .utils.trace import TRACER, device_profile
 
 __all__ = ["main", "build_parser"]
@@ -139,6 +140,16 @@ def build_parser() -> argparse.ArgumentParser:
                           "on every process; process 0 writes one merged "
                           "report with per-host snapshots and summed "
                           "totals")
+    run.add_argument("--doc-sample-rate", type=int, default=0, metavar="N",
+                     help="Sample 1-in-N documents for per-document "
+                          "tail-latency lineage: sampled docs are stamped "
+                          "at every stage seam and feed the "
+                          "doc_latency_* HDR histograms (p50/p95/p99 in "
+                          "the run report, /metrics, and the end-of-run "
+                          "summary) plus the live rollup windows on "
+                          "/telemetry.  Deterministic on the doc id, so "
+                          "multi-host runs sample the same documents on "
+                          "every host.  0 = off (zero hot-path cost)")
     run.add_argument("--quiet", action="store_true", help="Suppress progress output")
     run.add_argument("--checkpoint-dir", default=None,
                      help="Enable chunk-level checkpointing in this directory; "
@@ -290,6 +301,13 @@ def _cmd_run(args: argparse.Namespace) -> int:
             pid=args.process_id,
         )
 
+    if args.doc_sample_rate < 0:
+        print(f"Invalid --doc-sample-rate value: {args.doc_sample_rate}",
+              file=sys.stderr)
+        return 1
+    if args.doc_sample_rate > 0:
+        TELEMETRY.configure(args.doc_sample_rate)
+
     provenance = {
         "entry": "textblast run",
         "version": __version__,
@@ -303,6 +321,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
         "overlap_enabled": bool(config.overlap.enabled),
         "pipeline_depth": int(config.overlap.pipeline_depth),
         "num_processes": args.num_processes,
+        "doc_sample_rate": int(args.doc_sample_rate),
     }
     report_baseline = metrics_snapshot() if args.run_report else None
     funnel_before = funnel_snapshot()
@@ -483,8 +502,6 @@ def _cmd_run(args: argparse.Namespace) -> int:
             # before the hard exit.  Best-effort — the abort path must
             # never mask the diagnosis above.
             try:
-                from .utils.metrics import build_run_report, write_run_report
-
                 report = build_run_report(
                     baseline=report_baseline,
                     wall_time_s=time.perf_counter() - start,
@@ -512,6 +529,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
     finally:
         profile_ctx.__exit__(None, None, None)
         TRACER.close()
+        TELEMETRY.close()  # stops the rollup ticker; HDR state stays in METRICS
 
     elapsed = time.perf_counter() - start
     total = result.received
@@ -616,6 +634,8 @@ def _cmd_run(args: argparse.Namespace) -> int:
                 ),
                 file=sys.stderr,
             )
+        if args.doc_sample_rate > 0:
+            print(format_latency_summary(report_baseline), file=sys.stderr)
         if args.trace:
             print(f"Trace written -> {args.trace} "
                   "(load at https://ui.perfetto.dev)", file=sys.stderr)

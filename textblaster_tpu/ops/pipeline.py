@@ -53,6 +53,7 @@ from ..resilience.breaker import CircuitBreaker
 from ..resilience.faults import FAULTS
 from ..resilience.retry import RetryPolicy
 from ..utils.metrics import FILTER_DROP_PREFIX, METRICS
+from ..utils.telemetry import TELEMETRY
 from ..utils.trace import TRACER
 from ..utils.overlap import prefetch_iter
 from .badwords import badwords_matches_multi
@@ -1488,6 +1489,8 @@ class CompiledPipeline:
         (the double-buffered feed SURVEY.md §2.5 maps prefetch/QoS onto)."""
         FAULTS.fire("device.execute")
         record_occupancy(batch)
+        if TELEMETRY.enabled:
+            TELEMETRY.mark("dispatch", (d.id for d in batch.docs))
         with TRACER.span(
             "device_dispatch",
             {"bucket": batch.max_len, "rows": batch.batch_size,
@@ -1530,6 +1533,8 @@ class CompiledPipeline:
         the lockstep window packs rounds ahead on the shared pack pool and
         hands the resolved batches here, so this seam must stay pack-free."""
         FAULTS.fire("multihost.round")
+        if TELEMETRY.enabled:
+            TELEMETRY.mark("dispatch", (d.id for d in batch.docs))
         with TRACER.span(
             "device_dispatch",
             {"bucket": batch.max_len, "rows": batch.batch_size,
@@ -1565,6 +1570,8 @@ class CompiledPipeline:
             first[0] = None
             if stats is None:
                 stats = self.dispatch_batch(batch, phase)
+            if TELEMETRY.enabled:
+                TELEMETRY.mark("device_wait", (d.id for d in batch.docs))
             t0 = time.perf_counter()
             try:
                 with TRACER.span(
@@ -1676,6 +1683,8 @@ class CompiledPipeline:
         host-fallback reruns, and — on the last phase — passes); survivors
         are documents that passed a non-final phase and continue to the next.
         """
+        if TELEMETRY.enabled:
+            TELEMETRY.mark("assemble", (d.id for d in batch.docs))
         # ONE bundled transfer: on the remote-tunnel TPU backend each per-key
         # np.asarray is its own synchronous round trip (~0.7s/key measured,
         # 48 keys = 35s/batch); jax.device_get moves the whole tree in one
@@ -1776,6 +1785,8 @@ class CompiledPipeline:
 
         Runs once per batch on the pack pool's hot path — the clock comes
         from the module-scope import, not a per-call ``import time``."""
+        if TELEMETRY.enabled:
+            TELEMETRY.mark("pack", (d.id for d in docs))
         t0 = _time_mod.perf_counter()
         try:
             with TRACER.span(

@@ -826,9 +826,19 @@ def host_allgather(vec: np.ndarray) -> np.ndarray:
     # counter the batched verdict exchange drives down (a piggybacked
     # K-flag verdict vector is ONE post where K per-round flags were K).
     from ..utils.metrics import METRICS
+    from ..utils.telemetry import TELEMETRY
 
     METRICS.inc("multihost_exchange_posts_total")
-    return transport.allgather(arr)
+    t0 = time.perf_counter()
+    try:
+        return transport.allgather(arr)
+    finally:
+        dt = time.perf_counter() - t0
+        METRICS.inc("multihost_exchange_post_seconds_total", dt)
+        if TELEMETRY.enabled:
+            METRICS.observe_hdr(
+                "exchange_post_latency_seconds", int(dt * 1e6)
+            )
 
 
 def host_allgather_obj(obj) -> list:
@@ -2786,6 +2796,12 @@ def _main(argv: Optional[Sequence[str]] = None) -> int:
         help="process 0 writes a merged machine-readable run report "
         "(pass on every process — the snapshot exchange is a collective)",
     )
+    ap.add_argument(
+        "--doc-sample-rate", type=int, default=0, metavar="N",
+        help="sample 1-in-N documents for per-doc tail-latency lineage "
+        "(deterministic on the doc id, so every host samples the same "
+        "docs; 0 = off)",
+    )
     args = ap.parse_args(argv)
 
     if args.exchange_deadline_s <= args.lease_ttl_s:
@@ -2821,6 +2837,10 @@ def _main(argv: Optional[Sequence[str]] = None) -> int:
             process_name=f"textblast-host{args.process_id}",
             pid=args.process_id,
         )
+    if args.doc_sample_rate > 0:
+        from ..utils.telemetry import TELEMETRY
+
+        TELEMETRY.configure(args.doc_sample_rate)
 
     config = load_pipeline_config(args.pipeline_config)
     if args.no_overlap:
@@ -2858,10 +2878,15 @@ def _main(argv: Optional[Sequence[str]] = None) -> int:
                 "num_processes": args.num_processes,
                 "buckets": args.buckets,
                 "auto_geometry": args.auto_geometry,
+                "doc_sample_rate": args.doc_sample_rate,
             },
         )
     finally:
         TRACER.close()
+        if args.doc_sample_rate > 0:
+            from ..utils.telemetry import TELEMETRY
+
+            TELEMETRY.close()
     print(
         f"process {args.process_id}: {result.received} outcomes "
         f"({result.success} kept, {result.filtered} excluded)"

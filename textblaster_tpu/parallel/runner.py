@@ -35,6 +35,7 @@ from ..orchestration import (
 from ..pipeline_builder import build_pipeline_from_config
 from ..resilience.deadletter import DeadLetterSink
 from ..resilience.retry import RetryPolicy
+from ..utils.telemetry import TELEMETRY
 from ..utils.trace import TRACER
 
 logger = logging.getLogger(__name__)
@@ -171,6 +172,15 @@ def run_pipeline(
                             len(lengths),
                             geometry.describe(),
                         )
+                        if TELEMETRY.enabled:
+                            # Drift baseline: the waste this geometry implies
+                            # for the calibration sample — what the live
+                            # rollup windows are compared against.
+                            from ..utils.telemetry import expected_waste
+
+                            TELEMETRY.set_geometry_baseline(
+                                expected_waste(lengths, geometry)
+                            )
                     docs = chain(head, it)
 
             mesh = data_mesh() if len(jax.devices()) > 1 else None

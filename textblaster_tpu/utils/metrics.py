@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 import os
 import threading
 from collections import defaultdict
@@ -37,16 +38,122 @@ __all__ = [
     "format_funnel_summary",
     "metrics_snapshot",
     "resilience_report",
+    "latency_report",
+    "histogram_report",
     "build_run_report",
     "write_run_report",
     "RUN_REPORT_SCHEMA",
     "metrics_catalog_markdown",
+    "HDR_SUBBUCKET_BITS",
+    "HDR_RELATIVE_ERROR",
+    "HDR_SPECS",
+    "DOC_LATENCY_STAGES",
+    "hdr_bucket_index",
+    "hdr_bucket_high_us",
+    "hdr_quantile_us",
 ]
 
 # Histogram buckets mirroring the reference's defaults (prometheus crate).
 _DEFAULT_BUCKETS = (
     0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 )
+
+# --- log-linear (HDR-style) histograms --------------------------------------
+#
+# Integer-microsecond values land in log-linear buckets: each power-of-two
+# octave is split into 2**HDR_SUBBUCKET_BITS linear sub-buckets, so every
+# bucket's width is at most its lower bound / 2**bits — i.e. any recorded
+# value is reproduced by its bucket's upper bound within a bounded RELATIVE
+# error, across the full dynamic range (1 µs .. hours) with a few hundred
+# buckets at most.  All index math is pure-int and deterministic, and two
+# histograms over the same scheme merge by bucket-wise addition — the
+# property the multi-host run-report aggregation relies on for exact
+# gang-wide quantiles.
+
+HDR_SUBBUCKET_BITS = 5
+_HDR_M = 1 << HDR_SUBBUCKET_BITS  # sub-buckets per octave
+
+#: Worst-case relative error of a bucket-high readback vs the true value.
+HDR_RELATIVE_ERROR = 1.0 / _HDR_M
+
+
+def hdr_bucket_index(us: int) -> int:
+    """Bucket index for an integer-microsecond value (log-linear scheme)."""
+    v = int(us)
+    if v < 0:
+        v = 0
+    if v < _HDR_M:
+        return v  # first buckets are exact (width 1)
+    k = v.bit_length() - 1
+    sub = v >> (k - HDR_SUBBUCKET_BITS)  # in [M, 2M)
+    return ((k - HDR_SUBBUCKET_BITS + 1) << HDR_SUBBUCKET_BITS) + (sub - _HDR_M)
+
+
+def hdr_bucket_high_us(index: int) -> int:
+    """Inclusive upper bound (µs) of a bucket — the quantile readback value.
+
+    Strictly increasing in ``index``, and ``hdr_bucket_high_us(
+    hdr_bucket_index(v)) >= v`` with relative error <= HDR_RELATIVE_ERROR.
+    """
+    i = int(index)
+    if i < _HDR_M:
+        return i
+    e = (i >> HDR_SUBBUCKET_BITS) - 1
+    sub = (i & (_HDR_M - 1)) + _HDR_M
+    return ((sub + 1) << e) - 1
+
+
+def hdr_quantile_us(buckets: Dict[int, int], count: int, q: float) -> int:
+    """The q-quantile (µs) of a sparse ``{bucket_index: count}`` histogram.
+
+    Rank semantics: the value at position ``ceil(q * count)`` of the sorted
+    sample (1-based) — the "inverted CDF" definition, which is exact under
+    bucket-wise merge: the quantile of a merged histogram equals the
+    quantile of the concatenated samples (within the bucket error bound).
+    """
+    if count <= 0:
+        return 0
+    target = max(1, int(math.ceil(q * count)))
+    seen = 0
+    last = 0
+    for idx in sorted(buckets):
+        c = buckets[idx]
+        if c <= 0:
+            continue
+        last = idx
+        seen += c
+        if seen >= target:
+            return hdr_bucket_high_us(idx)
+    return hdr_bucket_high_us(last)
+
+
+#: Doc-lineage stage keys, in pipeline order, plus the end-to-end rollup —
+#: each backs a dynamic HDR family ``doc_latency_<stage>_seconds``.
+DOC_LATENCY_STAGES = (
+    "read", "pack", "dispatch", "device_wait", "assemble", "write", "e2e",
+)
+
+#: Dynamic HDR histogram families (populated via ``Metrics.observe_hdr``) —
+#: help strings for the exposition + the generated catalog.  Like the
+#: occupancy/filter families, members only exist once observed.
+HDR_SPECS: Dict[str, str] = {
+    **{
+        f"doc_latency_{stage}_seconds": (
+            "Sampled per-document latency through the "
+            f"'{stage}' stage (log-linear buckets, "
+            "relative error <= 1/32)"
+            if stage != "e2e"
+            else "Sampled per-document end-to-end latency, first stage "
+            "stamp to Parquet write (log-linear buckets, relative "
+            "error <= 1/32)"
+        )
+        for stage in DOC_LATENCY_STAGES
+    },
+    "exchange_post_latency_seconds": (
+        "Per-collective host_allgather post latency (log-linear buckets, "
+        "relative error <= 1/32)"
+    ),
+}
 
 # Metric name -> (type, help) — prometheus_metrics.rs:16-143.
 _SPECS: Dict[str, Tuple[str, str]] = {
@@ -272,6 +379,11 @@ _SPECS: Dict[str, Tuple[str, str]] = {
         "drives this down by piggybacking a window's fault flags into one "
         "vector post",
     ),
+    "multihost_exchange_post_seconds_total": (
+        "counter",
+        "Wall seconds inside host_allgather posts (transport round trip "
+        "included), across all collectives this process joined",
+    ),
     # Overlapped-pipeline stage accounting (no reference equivalent).  The
     # counters are wall seconds spent *inside* each stage, summed across
     # worker threads; with overlap on, stages run concurrently, so the sum
@@ -303,6 +415,10 @@ _SPECS: Dict[str, Tuple[str, str]] = {
         "counter",
         "Wall seconds writing outcome batches to Parquet",
     ),
+    "queue_depth_read": (
+        "gauge",
+        "Prefetched row-group blocks buffered ahead of the consumer",
+    ),
     "queue_depth_pack": (
         "gauge",
         "Packed batches waiting in the pack-stage queue",
@@ -314,6 +430,34 @@ _SPECS: Dict[str, Tuple[str, str]] = {
     "inflight_batches": (
         "gauge",
         "Device batches currently in flight (dispatched, not yet fetched)",
+    ),
+    # Per-document tail-latency telemetry (utils/telemetry.py): a
+    # deterministic doc-id sampler stamps sampled documents at every stage
+    # seam and feeds the dynamic doc_latency_* HDR histogram families.
+    "doc_sampled_total": (
+        "counter",
+        "Documents selected by the deterministic lineage sampler "
+        "(--doc-sample-rate)",
+    ),
+    "doc_lineage_evicted_total": (
+        "counter",
+        "Sampled document lineages evicted before reaching the write "
+        "stage (lineage table at capacity)",
+    ),
+    "writer_chars_total": (
+        "counter",
+        "Document characters written to Parquet output (telemetry runs "
+        "only; feeds the live chars/s rollup window)",
+    ),
+    "geometry_drift": (
+        "gauge",
+        "Relative deviation of the live padding-waste window from the "
+        "calibration-time baseline (max-merged across hosts)",
+    ),
+    "trace_events_dropped_total": (
+        "counter",
+        "Trace events dropped: ring overflow with no spill file, or a "
+        "spill write that failed (disk full / unwritable path)",
     ),
     # Device-occupancy accounting (ops/pipeline.py record_occupancy): a
     # compiled program computes every padded lane of its fixed shape, so
@@ -548,7 +692,11 @@ def format_funnel_summary(
 def metrics_snapshot() -> Dict[str, float]:
     """Full copy of every counter/gauge (dynamic families included) —
     the unit of cross-host exchange and the run-report baseline.
-    Histogram state is deliberately excluded (not needed by any report)."""
+    Histogram state rides along as flat ``name::b<i>`` / ``name::h<i>`` /
+    ``name::sum`` / ``name::count`` keys: every one is a monotone count, so
+    the cross-host sum-merge aggregates histograms bucket-wise exactly like
+    counters (the keys can't collide with real metric names — '::' never
+    appears in one)."""
     return METRICS.all_values()
 
 
@@ -576,9 +724,107 @@ def resilience_report(
     return out
 
 
+def _hdr_delta(
+    vals: Dict[str, float], base: Dict[str, float], name: str
+) -> Tuple[Dict[int, int], int, int]:
+    """Decode one HDR family from a flat snapshot, relative to a baseline.
+
+    Returns ``(sparse buckets, sum_us, count)`` with every count clamped at
+    zero — the inverse of the ``name::h<i>`` encoding ``all_values`` emits.
+    """
+    prefix = name + "::h"
+    buckets: Dict[int, int] = {}
+    for k, v in vals.items():
+        if k.startswith(prefix):
+            d = int(v) - int(base.get(k, 0))
+            if d > 0:
+                buckets[int(k[len(prefix):])] = d
+    sum_us = max(0, int(vals.get(name + "::sum", 0)) - int(base.get(name + "::sum", 0)))
+    count = max(0, int(vals.get(name + "::count", 0)) - int(base.get(name + "::count", 0)))
+    return buckets, sum_us, count
+
+
+def latency_report(
+    baseline: Optional[Dict[str, float]] = None,
+    values: Optional[Dict[str, float]] = None,
+) -> Dict[str, object]:
+    """Per-stage + end-to-end sampled-latency quantiles (the run report's
+    ``latency`` section).
+
+    Reads the encoded HDR families out of ``values`` (or the live registry)
+    relative to ``baseline``.  All math is pure-int bucket walking, so the
+    same merged snapshot always produces byte-identical quantile blocks —
+    the determinism the multi-host merged report relies on.
+    """
+    vals = values if values is not None else METRICS.all_values()
+    base = baseline or {}
+    stages: Dict[str, object] = {}
+    families = [(s, f"doc_latency_{s}_seconds") for s in DOC_LATENCY_STAGES]
+    families.append(("exchange_post", "exchange_post_latency_seconds"))
+    for stage, fam in families:
+        buckets, sum_us, count = _hdr_delta(vals, base, fam)
+        if count <= 0:
+            continue
+        stages[stage] = {
+            "count": count,
+            "mean_s": round(sum_us / count / 1e6, 6),
+            "p50_s": round(hdr_quantile_us(buckets, count, 0.50) / 1e6, 6),
+            "p95_s": round(hdr_quantile_us(buckets, count, 0.95) / 1e6, 6),
+            "p99_s": round(hdr_quantile_us(buckets, count, 0.99) / 1e6, 6),
+            "max_le_s": round(
+                hdr_bucket_high_us(max(buckets)) / 1e6, 6
+            ) if buckets else 0.0,
+        }
+    return {"relative_error": HDR_RELATIVE_ERROR, "stages": stages}
+
+
+def histogram_report(
+    baseline: Optional[Dict[str, float]] = None,
+    values: Optional[Dict[str, float]] = None,
+) -> Dict[str, object]:
+    """Fixed-bucket histogram deltas (the run report's ``histograms``
+    section) — the families ``observe`` feeds, which earlier report
+    versions silently dropped because snapshots excluded histogram state.
+
+    Buckets are per-bucket (non-cumulative) counts keyed by upper bound, so
+    a merged multi-host report's buckets equal the bucket-wise sum of the
+    per-host snapshots by construction."""
+    vals = values if values is not None else METRICS.all_values()
+    base = baseline or {}
+    out: Dict[str, object] = {}
+    for name, (mtype, _help) in _SPECS.items():
+        if mtype != "histogram":
+            continue
+        count = max(
+            0,
+            int(vals.get(f"{name}::count", 0)) - int(base.get(f"{name}::count", 0)),
+        )
+        if count <= 0:
+            continue
+        bucket_counts: Dict[str, int] = {}
+        for i in range(len(_DEFAULT_BUCKETS) + 1):
+            key = f"{name}::b{i}"
+            d = int(vals.get(key, 0)) - int(base.get(key, 0))
+            if d > 0:
+                le = "+Inf" if i == len(_DEFAULT_BUCKETS) else f"{_DEFAULT_BUCKETS[i]:g}"
+                bucket_counts[le] = d
+        total = max(
+            0.0,
+            float(vals.get(f"{name}::sum", 0.0)) - float(base.get(f"{name}::sum", 0.0)),
+        )
+        out[name] = {
+            "count": count,
+            "sum_s": round(total, 6),
+            "buckets": bucket_counts,
+        }
+    return out
+
+
 #: Schema identifier stamped into every run report (bump on breaking shape
-#: changes; consumers should match on it, not on key presence).
-RUN_REPORT_SCHEMA = "textblaster-run-report/v1"
+#: changes; consumers should match on it, not on key presence).  v2 adds
+#: the ``latency`` (per-stage HDR quantile blocks) and ``histograms``
+#: (fixed-bucket histogram deltas) sections.
+RUN_REPORT_SCHEMA = "textblaster-run-report/v2"
 
 
 def build_run_report(
@@ -601,6 +847,8 @@ def build_run_report(
         "wall_time_s": round(wall_time_s, 3) if wall_time_s is not None else None,
         "counts": dict(counts or {}),
         "stages": stage_breakdown(baseline, values),
+        "latency": latency_report(baseline, values),
+        "histograms": histogram_report(baseline, values),
         "occupancy": occupancy_report(baseline, values),
         "resilience": resilience_report(baseline, values),
         "funnel": funnel_report(baseline, values),
@@ -639,6 +887,8 @@ def metrics_catalog_markdown() -> str:
         f"| `{FILTER_DROP_PREFIX}<name>` | counter | Dynamic family: "
         "documents dropped by filter `<name>` |"
     )
+    for name, help_text in HDR_SPECS.items():
+        lines.append(f"| `{name}` | histogram | Dynamic family: {help_text} |")
     return "\n".join(lines)
 
 
@@ -651,6 +901,11 @@ class Metrics:
         self._hist_counts: Dict[str, List[int]] = {}
         self._hist_sum: Dict[str, float] = defaultdict(float)
         self._hist_total: Dict[str, int] = defaultdict(int)
+        # Log-linear histograms: sparse {bucket_index: count} per family,
+        # sums kept in integer microseconds so merges stay exact.
+        self._hdr: Dict[str, Dict[int, int]] = {}
+        self._hdr_sum_us: Dict[str, int] = defaultdict(int)
+        self._hdr_count: Dict[str, int] = defaultdict(int)
 
     def inc(self, name: str, amount: float = 1.0) -> None:
         with self._lock:
@@ -676,9 +931,31 @@ class Metrics:
             }
 
     def all_values(self) -> Dict[str, float]:
-        """Copy of every counter/gauge value (histograms excluded)."""
+        """Copy of every counter/gauge value, with histogram state encoded
+        as flat mergeable keys.
+
+        Fixed-bucket histograms contribute ``name::b<i>`` (per-bucket,
+        non-cumulative count) for every populated bucket plus ``name::sum``
+        / ``name::count``; HDR families contribute ``name::h<idx>`` plus
+        ``name::sum`` (µs) / ``name::count``.  Every encoded key is a
+        monotone count, so the multi-host snapshot merge (which sums
+        anything not declared a gauge) aggregates histograms bucket-wise
+        with no special casing — run reports no longer drop them."""
         with self._lock:
-            return dict(self._values)
+            out = dict(self._values)
+            for name, counts in self._hist_counts.items():
+                for i, c in enumerate(counts):
+                    if c:
+                        out[f"{name}::b{i}"] = float(c)
+                out[f"{name}::sum"] = self._hist_sum.get(name, 0.0)
+                out[f"{name}::count"] = float(self._hist_total.get(name, 0))
+            for name, buckets in self._hdr.items():
+                for idx, c in buckets.items():
+                    if c:
+                        out[f"{name}::h{idx}"] = float(c)
+                out[f"{name}::sum"] = float(self._hdr_sum_us.get(name, 0))
+                out[f"{name}::count"] = float(self._hdr_count.get(name, 0))
+            return out
 
     def observe(self, name: str, value: float) -> None:
         with self._lock:
@@ -694,12 +971,36 @@ class Metrics:
             self._hist_sum[name] += value
             self._hist_total[name] += 1
 
+    def observe_hdr(self, name: str, us: int) -> None:
+        """Record one integer-microsecond value into a log-linear family."""
+        v = max(0, int(us))
+        idx = hdr_bucket_index(v)
+        with self._lock:
+            fam = self._hdr.get(name)
+            if fam is None:
+                fam = self._hdr[name] = {}
+            fam[idx] = fam.get(idx, 0) + 1
+            self._hdr_sum_us[name] += v
+            self._hdr_count[name] += 1
+
+    def hdr_state(self, name: str) -> Tuple[Dict[int, int], int, int]:
+        """``(sparse buckets, sum_us, count)`` snapshot of one HDR family."""
+        with self._lock:
+            return (
+                dict(self._hdr.get(name, {})),
+                self._hdr_sum_us.get(name, 0),
+                self._hdr_count.get(name, 0),
+            )
+
     def reset(self) -> None:
         with self._lock:
             self._values.clear()
             self._hist_counts.clear()
             self._hist_sum.clear()
             self._hist_total.clear()
+            self._hdr.clear()
+            self._hdr_sum_us.clear()
+            self._hdr_count.clear()
 
     def render(self) -> str:
         """Prometheus text exposition format."""
@@ -724,6 +1025,31 @@ class Metrics:
                     lines.append(f'{name}_bucket{{le="+Inf"}} {cumulative}')
                     lines.append(f"{name}_sum {self._hist_sum.get(name, 0.0):g}")
                     lines.append(f"{name}_count {self._hist_total.get(name, 0)}")
+            # Dynamic HDR histogram families — exposed as ordinary
+            # Prometheus histograms: populated buckets become cumulative
+            # counts at their upper bound (seconds), closed by +Inf, with
+            # _sum/_count alongside.  Only buckets that received a sample
+            # are listed; bucket highs are strictly increasing in the
+            # index, so the le series is ascending by construction.
+            for name in sorted(self._hdr):
+                help_text = HDR_SPECS.get(
+                    name, "Log-linear latency histogram (microsecond base)"
+                )
+                lines.append(f"# HELP {name} {help_text}")
+                lines.append(f"# TYPE {name} histogram")
+                fam = self._hdr[name]
+                cumulative = 0
+                for idx in sorted(fam):
+                    cumulative += fam[idx]
+                    le = hdr_bucket_high_us(idx) / 1e6
+                    lines.append(
+                        f'{name}_bucket{{le="{le:.6f}"}} {cumulative}'
+                    )
+                lines.append(f'{name}_bucket{{le="+Inf"}} {cumulative}')
+                lines.append(
+                    f"{name}_sum {self._hdr_sum_us.get(name, 0) / 1e6:.6f}"
+                )
+                lines.append(f"{name}_count {self._hdr_count.get(name, 0)}")
             # Dynamic counter families — the member sets are only known at
             # runtime (buckets actually dispatched, filters that dropped).
             dyn = sorted(
@@ -760,13 +1086,26 @@ class _Handler(BaseHTTPRequestHandler):
         return self.path.split("?", 1)[0] == "/metrics"
 
     def _respond(self, send_body: bool) -> None:
-        if not self._is_metrics_path():
+        path = self.path.split("?", 1)[0]
+        if self._is_metrics_path():
+            body = METRICS.render().encode("utf-8")
+            ctype = "text/plain; version=0.0.4"
+        elif path == "/telemetry":
+            # Live rollup snapshot (JSON) next to the exposition.  Imported
+            # lazily: telemetry.py imports this module at load time, the
+            # reverse edge only exists inside a request.
+            from .telemetry import TELEMETRY
+
+            body = (
+                json.dumps(TELEMETRY.snapshot(), sort_keys=True) + "\n"
+            ).encode("utf-8")
+            ctype = "application/json"
+        else:
             self.send_response(404)
             self.end_headers()
             return
-        body = METRICS.render().encode("utf-8")
         self.send_response(200)
-        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         if send_body:

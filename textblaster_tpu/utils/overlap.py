@@ -101,6 +101,9 @@ class _PrefetchIterator:
             if self._done:
                 raise StopIteration
             got = self._queue.get()
+            # Per-block (never per-item): the gauge feeds the live rollup's
+            # read-queue track the same way ThreadedWriter feeds write's.
+            METRICS.set("queue_depth_read", self._queue.qsize())
             if got is _DONE:
                 self._done = True
                 raise StopIteration

@@ -46,6 +46,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import sys
 import threading
 import time
 from contextlib import contextmanager
@@ -98,6 +99,7 @@ class Tracer:
         self._ring: List[Dict[str, Any]] = []
         self._ring_cap = 65536
         self._dropped = 0
+        self._warned_drop = False
         self._path: Optional[str] = None
         self._fh = None
         self._wrote_any = False
@@ -127,6 +129,7 @@ class Tracer:
             self._ring = []
             self._ring_cap = max(16, int(ring))
             self._dropped = 0
+            self._warned_drop = False
             self._tids = {}
             self._path = path
             self._fh = None
@@ -159,9 +162,15 @@ class Tracer:
             self.enabled = False
             if self._fh is not None:
                 self._spill_locked()
-                self._fh.write("\n]\n")
-                self._fh.close()
-                self._fh = None
+                if self._fh is not None:  # spill failure closes the file
+                    try:
+                        self._fh.write("\n]\n")
+                        self._fh.close()
+                    except OSError as e:
+                        logger.warning(
+                            "Trace close on %s failed: %s", self._path, e
+                        )
+                    self._fh = None
             if self._dropped:
                 logger.warning(
                     "Trace ring overflowed in-memory mode: %d events dropped",
@@ -305,8 +314,29 @@ class Tracer:
             else:
                 # In-memory mode: drop the oldest half, keep counting.
                 drop = len(self._ring) // 2
-                self._dropped += drop
+                self._count_dropped_locked(drop)
                 del self._ring[:drop]
+
+    def _count_dropped_locked(self, n: int) -> None:
+        """Account ``n`` dropped events: local counter, the
+        ``trace_events_dropped_total`` metric, and a one-line stderr
+        warning on the first drop (drops used to be silent — an unwritable
+        spill path lost the whole ring with no sign anywhere)."""
+        self._dropped += n
+        first = not self._warned_drop
+        self._warned_drop = True
+        # Lazy import: metrics.py and trace.py are both leaf modules; the
+        # one edge lives inside this rarely-hit path to keep it that way.
+        from .metrics import METRICS
+
+        METRICS.inc("trace_events_dropped_total", n)
+        if first:
+            print(
+                f"textblast: trace events dropped ({n} so far) — ring "
+                "overflow or unwritable spill file; trace will be "
+                "incomplete",
+                file=sys.stderr,
+            )
 
     def _spill_locked(self) -> None:
         if not self._ring:
@@ -317,8 +347,20 @@ class Tracer:
                 chunks.append(",\n")
             self._wrote_any = True
             chunks.append(json.dumps(ev, separators=(",", ":")))
-        self._fh.write("".join(chunks))
-        self._fh.flush()
+        try:
+            self._fh.write("".join(chunks))
+            self._fh.flush()
+        except OSError as e:
+            # Disk full / revoked path: count every event we just lost,
+            # warn once, and stop spilling (the ring keeps the newest
+            # events in memory so close() still has something to report).
+            self._count_dropped_locked(len(self._ring))
+            logger.warning("Trace spill to %s failed: %s", self._path, e)
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None
         self._ring = []
 
 
