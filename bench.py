@@ -1523,6 +1523,69 @@ pipeline:
             TELEMETRY.close()
             shutil.rmtree(telem_tmp, ignore_errors=True)
 
+    # --- Device-profiling overhead, A/B (BENCH_PROFILE=0 skips).  Both
+    # arms run the full pipeline INCLUDING the Parquet write seam, like the
+    # telemetry A/B: the profiler's dispatch seam fires inside the device
+    # fetch, but the honest denominator is end-to-end docs/s.  Off must be
+    # free (one attribute check per dispatch); on pays an HDR observe + a
+    # gauge set + a heap push per dispatch and must stay within ~2%.
+    profile_report = None
+    if os.environ.get("BENCH_PROFILE", "1") != "0":
+        import shutil
+        import tempfile
+
+        from textblaster_tpu.orchestration import aggregate_results_from_stream
+        from textblaster_tpu.utils.profiler import (
+            PROFILER,
+            device_profile_report,
+        )
+
+        prof_tmp = tempfile.mkdtemp(prefix="bench_prof_")
+
+        def _prof_pass(tag: str) -> float:
+            run = [d.copy() for d in docs]
+            t0 = time.perf_counter()
+            aggregate_results_from_stream(
+                process_documents_device(config, iter(run), pipeline=pipeline),
+                output_file=os.path.join(prof_tmp, f"{tag}_out.parquet"),
+                excluded_file=os.path.join(prof_tmp, f"{tag}_exc.parquet"),
+            )
+            return time.perf_counter() - t0
+
+        try:
+            prof_off_s = [_prof_pass(f"off{i}") for i in range(2)]
+            prof_base = metrics_snapshot()
+            PROFILER.configure()
+            # Warmup already ran with profiling off, so the compile-time
+            # capture never fired — re-register the installed executables'
+            # cost models directly (no compiles, no cache traffic).
+            pipeline.register_installed_costs(include_split_rows=False)
+            prof_on_s = [_prof_pass(f"on{i}") for i in range(2)]
+            dp = device_profile_report(baseline=prof_base)
+            prof_off_rate = len(docs) / min(prof_off_s)
+            prof_on_rate = len(docs) / min(prof_on_s)
+            profile_report = {
+                "profile_on_docs_per_sec": round(prof_on_rate, 2),
+                "profile_off_docs_per_sec": round(prof_off_rate, 2),
+                "overhead_frac": round(1.0 - prof_on_rate / prof_off_rate, 4),
+                "cost_fingerprint": dp.get("cost_fingerprint"),
+                "dispatch": dp.get("dispatch"),
+                "top_dispatches": dp.get("top_dispatches", [])[:3],
+            }
+            _log(
+                f"profile: {prof_on_rate:.1f} docs/s on vs "
+                f"{prof_off_rate:.1f} off "
+                f"(overhead {profile_report['overhead_frac']:+.2%}, "
+                f"fingerprint "
+                f"{str(profile_report['cost_fingerprint'])[:12]})"
+            )
+        except Exception as e:  # never bill a profiler problem to the bench
+            profile_report = {"error": f"{type(e).__name__}: {e}"[:500]}
+            _log(f"profile A/B skipped: {e}")
+        finally:
+            PROFILER.close()
+            shutil.rmtree(prof_tmp, ignore_errors=True)
+
     # Noise self-diagnosis: spreads over the raw passes plus the load
     # averages bracketing each side.  The bench's own process keeps a 1-core
     # box at load ~1; sustained load beyond ~1.8 means a foreign process was
@@ -1640,6 +1703,11 @@ pipeline:
         # per-stage tail quantiles for the sampled docs plus the overhead
         # the 1-in-N sampler costs (off must be free, on low single digits).
         **({"telemetry": telemetry_report} if telemetry_report else {}),
+        # Device-profiling on/off A/B through the full write path: the cost
+        # fingerprint, per-(bucket, phase) device-time quantiles with
+        # modeled-vs-achieved bytes/s, and the overhead the observatory
+        # costs (off must be free, on within ~2%).
+        **({"device_profile": profile_report} if profile_report else {}),
         # The merged observability report for the 3 timed passes — same
         # schema as `--run-report` (stages, occupancy, resilience, funnel).
         "run_report": run_report,

@@ -106,6 +106,31 @@ def test_http_endpoint_ignores_query_string_and_serves_head():
         server.shutdown()
 
 
+def test_telemetry_endpoint_query_string_and_head_parity():
+    # The live-telemetry endpoint must accept the same scraper quirks as
+    # /metrics: query strings stripped before routing, HEAD served with a
+    # correct Content-Length and an empty body.
+    server = setup_prometheus_metrics(0)
+    assert server is not None
+    try:
+        port = server.server_address[1]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/telemetry?window=60"
+        ) as resp:
+            assert resp.status == 200
+            body = resp.read().decode()
+        assert body  # JSON snapshot, even when telemetry is off
+        head = urllib.request.Request(
+            f"http://127.0.0.1:{port}/telemetry", method="HEAD"
+        )
+        with urllib.request.urlopen(head) as resp:
+            assert resp.status == 200
+            assert int(resp.headers["Content-Length"]) > 0
+            assert resp.read() == b""
+    finally:
+        server.shutdown()
+
+
 def test_no_port_means_no_server():
     assert setup_prometheus_metrics(None) is None
 

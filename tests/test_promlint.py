@@ -12,6 +12,8 @@ family populated so the generated HELP/TYPE text is linted too.
 import re
 
 from textblaster_tpu.utils.metrics import (
+    DEVICE_BPS_PREFIX,
+    DEVICE_TIME_PREFIX,
     FILTER_DROP_PREFIX,
     OCCUPANCY_BUCKET_PREFIX,
     Metrics,
@@ -45,6 +47,12 @@ def _populated_registry() -> Metrics:
         m.observe_hdr("doc_latency_e2e_seconds", us)
     m.observe_hdr("doc_latency_write_seconds", 1_200)
     m.observe_hdr("exchange_post_latency_seconds", 850)
+    # Device-profiling families: a per-(bucket, phase) dispatch-time HDR
+    # histogram and its roofline achieved-bytes/s gauge.
+    for us in (120, 3_500, 80_000):
+        m.observe_hdr(DEVICE_TIME_PREFIX + "256_phase_0_seconds", us)
+    m.observe_hdr(DEVICE_TIME_PREFIX + "512_phase_1_seconds", 9_000)
+    m.set(DEVICE_BPS_PREFIX + "256_phase_0", 1.25e9)
     return m
 
 
@@ -128,6 +136,8 @@ def test_hdr_families_expose_full_histogram_shape():
         "doc_latency_e2e_seconds",
         "doc_latency_write_seconds",
         "exchange_post_latency_seconds",
+        DEVICE_TIME_PREFIX + "256_phase_0_seconds",
+        DEVICE_TIME_PREFIX + "512_phase_1_seconds",
     ):
         assert f"# TYPE {family} histogram" in text, family
         bucket_lines = [

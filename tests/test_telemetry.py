@@ -239,14 +239,16 @@ def test_latency_report_reads_deltas_against_baseline():
     assert rep["stages"]["write"]["p50_s"] >= 200 / 1e6
 
 
-def test_run_report_v2_has_latency_and_histogram_sections():
-    assert RUN_REPORT_SCHEMA == "textblaster-run-report/v2"
+def test_run_report_v3_has_latency_and_histogram_sections():
+    assert RUN_REPORT_SCHEMA == "textblaster-run-report/v3"
     m = Metrics()
     m.observe_hdr("doc_latency_e2e_seconds", 5000)
     m.observe("worker_task_processing_duration_seconds", 0.01)
     report = build_run_report(baseline={}, values=m.all_values(), wall_time_s=1.0)
     assert report["schema"] == RUN_REPORT_SCHEMA
     assert report["latency"]["stages"]["e2e"]["count"] == 1
+    # v3 adds the device_profile section (empty-dispatch shape here).
+    assert "device_profile" in report
     hists = report["histograms"]
     fam = hists["worker_task_processing_duration_seconds"]
     assert fam["count"] == 1

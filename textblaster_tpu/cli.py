@@ -33,6 +33,7 @@ from .utils.metrics import (
     setup_prometheus_metrics,
     write_run_report,
 )
+from .utils.profiler import PROFILER
 from .utils.telemetry import TELEMETRY, format_latency_summary
 from .utils.trace import TRACER, device_profile
 
@@ -150,6 +151,17 @@ def build_parser() -> argparse.ArgumentParser:
                           "/telemetry.  Deterministic on the doc id, so "
                           "multi-host runs sample the same documents on "
                           "every host.  0 = off (zero hot-path cost)")
+    run.add_argument("--profile", action="store_true",
+                     help="Device-time attribution: capture the XLA cost "
+                          "model (flops/bytes per compiled program, AOT "
+                          "cache hits included), per-(bucket, phase) "
+                          "device-time histograms with roofline "
+                          "utilization gauges, a top-K slowest-dispatch "
+                          "table, and — on the multihost path — the "
+                          "lockstep stall decomposition.  Lands in the "
+                          "run report's device_profile section, /metrics, "
+                          "and --trace span args.  Off by default (the "
+                          "hot path then pays a single attribute check)")
     run.add_argument("--quiet", action="store_true", help="Suppress progress output")
     run.add_argument("--checkpoint-dir", default=None,
                      help="Enable chunk-level checkpointing in this directory; "
@@ -307,6 +319,8 @@ def _cmd_run(args: argparse.Namespace) -> int:
         return 1
     if args.doc_sample_rate > 0:
         TELEMETRY.configure(args.doc_sample_rate)
+    if args.profile:
+        PROFILER.configure()
 
     provenance = {
         "entry": "textblast run",
@@ -322,6 +336,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
         "pipeline_depth": int(config.overlap.pipeline_depth),
         "num_processes": args.num_processes,
         "doc_sample_rate": int(args.doc_sample_rate),
+        "profile": bool(args.profile),
     }
     report_baseline = metrics_snapshot() if args.run_report else None
     funnel_before = funnel_snapshot()
@@ -400,6 +415,11 @@ def _cmd_run(args: argparse.Namespace) -> int:
     # env form reaches paths that build their pipeline deep inside the
     # multi-host negotiation layers (ops.pipeline.should_warmup reads it).
     warmup_opt = {"auto": None, "on": True, "off": False}[args.warmup]
+    if args.profile and warmup_opt is None:
+        # The cost model is captured at warmup compile/AOT-load time; the
+        # CPU default (lazy first-dispatch compiles) would leave it empty.
+        # An explicit --warmup off still wins: timing-only profile.
+        warmup_opt = True
     if warmup_opt is not None:
         os.environ["TEXTBLAST_WARMUP"] = "1" if warmup_opt else "0"
 
@@ -530,6 +550,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
         profile_ctx.__exit__(None, None, None)
         TRACER.close()
         TELEMETRY.close()  # stops the rollup ticker; HDR state stays in METRICS
+        PROFILER.close()  # stops recording; captured state stays for the report
 
     elapsed = time.perf_counter() - start
     total = result.received
@@ -636,6 +657,17 @@ def _cmd_run(args: argparse.Namespace) -> int:
             )
         if args.doc_sample_rate > 0:
             print(format_latency_summary(report_baseline), file=sys.stderr)
+        if args.profile:
+            fp = PROFILER.cost_fingerprint()
+            top = PROFILER.top_dispatches()
+            line = f"Device profile: cost fingerprint {str(fp)[:12]}"
+            if top:
+                worst = top[0]
+                line += (
+                    f"; slowest dispatch {worst['seconds'] * 1e3:.1f} ms "
+                    f"(bucket {worst['bucket']}, phase {worst['phase']})"
+                )
+            print(line, file=sys.stderr)
         if args.trace:
             print(f"Trace written -> {args.trace} "
                   "(load at https://ui.perfetto.dev)", file=sys.stderr)

@@ -53,6 +53,7 @@ from ..resilience.breaker import CircuitBreaker
 from ..resilience.faults import FAULTS
 from ..resilience.retry import RetryPolicy
 from ..utils.metrics import FILTER_DROP_PREFIX, METRICS
+from ..utils.profiler import PROFILER
 from ..utils.telemetry import TELEMETRY
 from ..utils.trace import TRACER
 from ..utils.overlap import prefetch_iter
@@ -850,6 +851,7 @@ class CompiledPipeline:
             config_fingerprint,
             program_cache_key,
         )
+        from ..utils.profiler import program_cost
 
         stats = WarmupStats()
         t0 = _time.perf_counter()
@@ -896,6 +898,22 @@ class CompiledPipeline:
                 if compiled is not None:
                     stats.cache_hits += 1
                     self._jitted[key] = compiled
+                    if PROFILER.enabled:
+                        # Cost model survives the cache hit: the sidecar
+                        # holds the numbers captured at compile time; a
+                        # missing sidecar (pre-profiler entry) falls back
+                        # to re-analyzing the deserialized executable and
+                        # backfills the sidecar for the next warm start.
+                        cost = cache.load_cost(aot_key)
+                        source = "aot-sidecar"
+                        if cost is None:
+                            cost = program_cost(compiled)
+                            source = "aot-recompute"
+                            if cost is not None:
+                                cache.store_cost(aot_key, cost)
+                        PROFILER.record_program_cost(
+                            length, phase, rows, cost, source
+                        )
                     if warm_dispatch:
                         loaded.append((key, length, rows, compiled))
                     continue
@@ -939,6 +957,13 @@ class CompiledPipeline:
                 raise last
             with lock:
                 stats.compile_s += _time.perf_counter() - t
+            if PROFILER.enabled:
+                cost = program_cost(compiled)
+                PROFILER.record_program_cost(
+                    key[0], key[1], rows, cost, "compile"
+                )
+                if cache is not None and aot_key is not None and cost:
+                    cache.store_cost(aot_key, cost)
             if cache is not None and aot_key is not None:
                 if cache.store(aot_key, compiled):
                     with lock:
@@ -973,6 +998,31 @@ class CompiledPipeline:
                 _toggle_xla_compilation_cache(True)
         stats.total_s = _time.perf_counter() - t0
         return stats
+
+    def register_installed_costs(
+        self, include_split_rows: bool = True
+    ) -> int:
+        """Re-register the installed executables' static cost models with
+        the PROFILER — for observers armed AFTER warmup (bench A/B,
+        tests): the warmup seams only capture when profiling was on at
+        compile/load time, and a second ``warmup_parallel`` skips programs
+        that are already installed.  Returns the number registered."""
+        from ..utils.profiler import program_cost
+
+        n = 0
+        for key, length, phase, rows in self._warmup_jobs(
+            include_split_rows
+        ):
+            fn = self._jitted.get(key)
+            if fn is None or hasattr(fn, "lower"):
+                continue  # missing, or still a jitted wrapper (no analysis)
+            cost = program_cost(fn)
+            if cost:
+                PROFILER.record_program_cost(
+                    length, phase, rows, cost, "installed"
+                )
+                n += 1
+        return n
 
     # --- host finalizers ----------------------------------------------------
     #
@@ -1577,8 +1627,21 @@ class CompiledPipeline:
                 with TRACER.span(
                     "device_wait",
                     {"bucket": batch.max_len, "phase": phase},
-                ):
-                    return jax.device_get(stats)
+                ) as sp:
+                    out = jax.device_get(stats)
+                    if PROFILER.enabled:
+                        # Duration must be taken inside the span: the event
+                        # is emitted at __exit__, so args attached later
+                        # would miss the trace.
+                        sp.add_args(
+                            PROFILER.record_dispatch(
+                                batch.max_len,
+                                phase,
+                                batch.batch_size,
+                                time.perf_counter() - t0,
+                            )
+                        )
+                    return out
             finally:
                 # Time blocked on device results (transfer + any compute not
                 # yet finished).  Identity-fast for already-numpy stats, so

@@ -891,6 +891,32 @@ def _local_stats(out: dict) -> dict:
     }
 
 
+def _timed_stats(out: dict, bucket: int, phase: int, rows: int) -> dict:
+    """``_local_stats`` with device-wait attribution.
+
+    The lockstep path fetches shard trees directly — it never goes through
+    the single-host ``_device_fetch`` seam — so this wrapper is where its
+    blocked-on-device time lands in ``stage_device_wait_seconds`` (the
+    counter the window decomposition subtracts from window stall) and,
+    when profiling is on, in the per-(bucket, phase) device-time
+    histograms.  A faulted fetch still books the wait (matching
+    ``_device_fetch``'s ``finally``) but records no dispatch sample."""
+    from ..utils.metrics import METRICS
+    from ..utils.profiler import PROFILER
+
+    t0 = time.perf_counter()
+    ok = False
+    try:
+        stats = _local_stats(out)
+        ok = True
+    finally:
+        dt = time.perf_counter() - t0
+        METRICS.inc("stage_device_wait_seconds", dt)
+        if ok and PROFILER.enabled:
+            PROFILER.record_dispatch(bucket, phase, rows, dt)
+    return stats
+
+
 def _negotiate_max(needed_local: np.ndarray) -> np.ndarray:
     """Columnwise max of every process's per-bucket round counts.
 
@@ -1313,8 +1339,11 @@ def run_local_shard(
                         with TRACER.span(
                             "lockstep_resolve", {"bucket": eb, "phase": ph}
                         ):
+                            rows = local.batch_size
                             if guard is None:
-                                stats = _local_stats(entry["out"])
+                                stats = _timed_stats(
+                                    entry["out"], eb, ph, rows
+                                )
                             else:
                                 stats = guard.run_round(
                                     eb,
@@ -1323,7 +1352,9 @@ def run_local_shard(
                                             local, ph, sh2, sh1
                                         )
                                     ),
-                                    fetch=_local_stats,
+                                    fetch=lambda out: _timed_stats(
+                                        out, eb, ph, rows
+                                    ),
                                     inflight=entry["out"],
                                     launch_fault=entry["fault"],
                                     on_fault=drain_window,
@@ -1376,7 +1407,12 @@ def run_local_shard(
                         fault, st = bool(entry["fault"]), None
                         if not fault:
                             try:
-                                st = _local_stats(entry["out"])
+                                st = _timed_stats(
+                                    entry["out"],
+                                    entry["bucket"],
+                                    entry["phase"],
+                                    entry["batch"].batch_size,
+                                )
                             except BaseException as e:  # noqa: BLE001
                                 if classify_error(e) != "retryable":
                                     raise
@@ -1411,7 +1447,9 @@ def run_local_shard(
                                             local, ph, sh2, sh1
                                         )
                                     ),
-                                    fetch=_local_stats,
+                                    fetch=lambda out, eb=eb, ph=ph, rows=(
+                                        local.batch_size
+                                    ): _timed_stats(out, eb, ph, rows),
                                     on_fault=drain_window,
                                     prior_fault=True,
                                     prior_local_fault=faults[i],

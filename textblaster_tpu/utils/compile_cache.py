@@ -63,6 +63,15 @@ DEFAULT_AOT_DIR = os.path.join(_CACHE_ROOT, "aot")
 
 _SUFFIX = ".aotx"
 
+#: Static cost-model sidecar written next to each executable entry
+#: (``<key>.cost.json``): the ``cost_analysis``/``memory_analysis``
+#: numbers captured at compile time, so an AOT cache hit keeps the exact
+#: cost model of the compile that produced it (re-running the analyses on
+#: a deserialized executable is backend-dependent).  Sidecars ride their
+#: entry's lifecycle — evicted together, never counted against the size
+#: cap (a few hundred bytes each).
+_COST_SUFFIX = ".cost.json"
+
 
 def enable_compilation_cache(cache_dir: str | None = None) -> str:
     """Point JAX's persistent compilation cache at ``cache_dir`` (created if
@@ -241,6 +250,49 @@ class AOTExecutableCache:
     def _path(self, key: str) -> str:
         return os.path.join(self.cache_dir, key + _SUFFIX)
 
+    def _cost_path(self, key: str) -> str:
+        return os.path.join(self.cache_dir, key + _COST_SUFFIX)
+
+    def load_cost(self, key: str):
+        """The cost-model sidecar for ``key`` as a dict, or None (absent,
+        bypassed, corrupt — the latter evicted, like executables)."""
+        if not aot_cache_enabled():
+            return None
+        path = self._cost_path(key)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                cost = json.load(f)
+        except FileNotFoundError:
+            return None
+        except Exception as e:  # corrupt / truncated
+            logger.warning("evicting corrupt cost sidecar %s: %s", key, e)
+            _unlink_quiet(path)
+            return None
+        if not isinstance(cost, dict):
+            _unlink_quiet(path)
+            return None
+        return cost
+
+    def store_cost(self, key: str, cost) -> bool:
+        """Write the cost-model sidecar for ``key`` (atomic tmp + rename);
+        returns True on success.  Failures are warnings, never fatal."""
+        if not aot_cache_enabled() or not isinstance(cost, dict):
+            return False
+        try:
+            os.makedirs(self.cache_dir, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=self.cache_dir, suffix=".tmp")
+            try:
+                with os.fdopen(fd, "w", encoding="utf-8") as f:
+                    json.dump(cost, f, sort_keys=True)
+                os.replace(tmp, self._cost_path(key))
+            finally:
+                if os.path.exists(tmp):  # replace failed
+                    _unlink_quiet(tmp)
+        except OSError as e:  # pragma: no cover - disk full etc.
+            logger.warning("cost sidecar write failed for %s: %s", key, e)
+            return False
+        return True
+
     def load(self, key: str):
         """Return the deserialized executable for ``key``, or None on any
         miss (absent, bypassed, unsupported, corrupt — the latter evicted)."""
@@ -255,6 +307,7 @@ class AOTExecutableCache:
         except Exception as e:  # corrupt / truncated / wrong pickle
             logger.warning("evicting corrupt AOT cache entry %s: %s", key, e)
             _unlink_quiet(path)
+            _unlink_quiet(self._cost_path(key))
             return None
         try:
             from jax.experimental.serialize_executable import deserialize_and_load
@@ -263,6 +316,7 @@ class AOTExecutableCache:
         except Exception as e:  # runtime/topology mismatch that beat the key
             logger.warning("evicting unloadable AOT cache entry %s: %s", key, e)
             _unlink_quiet(path)
+            _unlink_quiet(self._cost_path(key))
             return None
         try:
             os.utime(path, None)  # LRU recency
@@ -334,6 +388,7 @@ class AOTExecutableCache:
             if total <= self.max_bytes:
                 break
             _unlink_quiet(path)
+            _unlink_quiet(path[: -len(_SUFFIX)] + _COST_SUFFIX)
             total -= size
             evicted += 1
         if evicted:

@@ -33,6 +33,8 @@ __all__ = [
     "occupancy_report",
     "format_occupancy_summary",
     "FILTER_DROP_PREFIX",
+    "DEVICE_TIME_PREFIX",
+    "DEVICE_BPS_PREFIX",
     "funnel_snapshot",
     "funnel_report",
     "format_funnel_summary",
@@ -489,6 +491,32 @@ OCCUPANCY_BUCKET_PREFIX = "occupancy_dispatches_bucket_"
 #: row count by construction.
 FILTER_DROP_PREFIX = "filter_dropped_total_"
 
+#: Per-(bucket, phase) device-time HDR histogram families are dynamic —
+#: one family per (bucket length, phase) actually dispatched
+#: (``device_time_bucket_<L>_phase_<P>_seconds``, fed by
+#: ``utils.profiler.PROFILER.record_dispatch``); ``render`` and the
+#: ``device_profile`` report section discover them by this prefix.
+DEVICE_TIME_PREFIX = "device_time_bucket_"
+
+#: Roofline-style achieved-bandwidth gauges are dynamic too — one gauge
+#: per (bucket, phase) (``device_achieved_bytes_per_s_bucket_<L>_phase_
+#: <P>``): the program's modeled bytes accessed divided by the latest
+#: dispatch's blocked-on-device seconds.
+DEVICE_BPS_PREFIX = "device_achieved_bytes_per_s_bucket_"
+
+
+def _dynamic_hdr_help(name: str) -> str:
+    """HELP text for a dynamic HDR family not listed in ``HDR_SPECS``."""
+    if name.startswith(DEVICE_TIME_PREFIX):
+        body = name[len(DEVICE_TIME_PREFIX):]
+        return (
+            f"Per-dispatch blocked-on-device wall time at bucket_phase "
+            f"{body.replace('_seconds', '')} (log-linear buckets, "
+            "relative error <= 1/32)"
+        )
+    return "Log-linear latency histogram (microsecond base)"
+
+
 #: The per-stage wall-time counters, in pipeline order.
 STAGE_COUNTERS = (
     "stage_read_seconds",
@@ -823,8 +851,27 @@ def histogram_report(
 #: Schema identifier stamped into every run report (bump on breaking shape
 #: changes; consumers should match on it, not on key presence).  v2 adds
 #: the ``latency`` (per-stage HDR quantile blocks) and ``histograms``
-#: (fixed-bucket histogram deltas) sections.
-RUN_REPORT_SCHEMA = "textblaster-run-report/v2"
+#: (fixed-bucket histogram deltas) sections; v3 adds ``device_profile``
+#: (static cost model, per-(bucket, phase) device-time quantiles, roofline
+#: gauges, top-K dispatches, lockstep decomposition).
+RUN_REPORT_SCHEMA = "textblaster-run-report/v3"
+
+
+def _device_profile_section(
+    baseline: Optional[Dict[str, float]] = None,
+    values: Optional[Dict[str, float]] = None,
+) -> Dict[str, object]:
+    """The ``device_profile`` report section, built by utils/profiler.py.
+    Imported lazily (profiler.py imports this module at load time; the
+    reverse edge only exists inside a report build) and never allowed to
+    fail the report."""
+    try:
+        from .profiler import device_profile_report
+
+        return device_profile_report(baseline, values)
+    except Exception as e:  # noqa: BLE001 — observability must not kill a run
+        logger.warning("device_profile section skipped: %s", e)
+        return {}
 
 
 def build_run_report(
@@ -852,6 +899,7 @@ def build_run_report(
         "occupancy": occupancy_report(baseline, values),
         "resilience": resilience_report(baseline, values),
         "funnel": funnel_report(baseline, values),
+        "device_profile": _device_profile_section(baseline, values),
         "config": dict(provenance or {}),
     }
     if hosts is not None:
@@ -889,6 +937,17 @@ def metrics_catalog_markdown() -> str:
     )
     for name, help_text in HDR_SPECS.items():
         lines.append(f"| `{name}` | histogram | Dynamic family: {help_text} |")
+    lines.append(
+        f"| `{DEVICE_TIME_PREFIX}<L>_phase_<P>_seconds` | histogram | "
+        "Dynamic family: per-dispatch blocked-on-device wall time at "
+        "bucket length `<L>`, phase `<P>` (log-linear buckets, relative "
+        "error <= 1/32; fed by the profiler) |"
+    )
+    lines.append(
+        f"| `{DEVICE_BPS_PREFIX}<L>_phase_<P>` | gauge | Dynamic family: "
+        "achieved device bytes/s (modeled bytes accessed / last dispatch "
+        "wait) at bucket length `<L>`, phase `<P>` |"
+    )
     return "\n".join(lines)
 
 
@@ -1032,9 +1091,7 @@ class Metrics:
             # are listed; bucket highs are strictly increasing in the
             # index, so the le series is ascending by construction.
             for name in sorted(self._hdr):
-                help_text = HDR_SPECS.get(
-                    name, "Log-linear latency histogram (microsecond base)"
-                )
+                help_text = HDR_SPECS.get(name) or _dynamic_hdr_help(name)
                 lines.append(f"# HELP {name} {help_text}")
                 lines.append(f"# TYPE {name} histogram")
                 fam = self._hdr[name]
@@ -1071,6 +1128,16 @@ class Metrics:
                     f"{name[len(FILTER_DROP_PREFIX):]}"
                 )
                 lines.append(f"# TYPE {name} counter")
+                lines.append(f"{name} {self._values[name]:g}")
+            for name in sorted(
+                k for k in self._values if k.startswith(DEVICE_BPS_PREFIX)
+            ):
+                lines.append(
+                    f"# HELP {name} Achieved device bytes/s (modeled bytes "
+                    f"accessed / last dispatch wait) at bucket_phase "
+                    f"{name[len(DEVICE_BPS_PREFIX):]}"
+                )
+                lines.append(f"# TYPE {name} gauge")
                 lines.append(f"{name} {self._values[name]:g}")
             return "\n".join(lines) + "\n"
 

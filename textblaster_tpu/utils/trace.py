@@ -69,6 +69,9 @@ class _NullSpan:
     def __exit__(self, *exc):
         return False
 
+    def add_args(self, args) -> None:
+        """No-op counterpart of :meth:`_Span.add_args`."""
+
 
 _NULL_SPAN = _NullSpan()
 
@@ -84,6 +87,15 @@ class _Span:
     def __enter__(self):
         self._t0 = time.perf_counter()
         return self
+
+    def add_args(self, args: Dict[str, Any]) -> None:
+        """Merge args discovered mid-span (e.g. the profiler's achieved
+        bytes/s, known only once the device wait resolves) into the event
+        emitted at exit.  Copies — the entry dict may be caller-shared."""
+        if self._args is None:
+            self._args = dict(args)
+        else:
+            self._args = {**self._args, **args}
 
     def __exit__(self, *exc):
         self._tracer._complete(self._name, self._t0, time.perf_counter(), self._args)
