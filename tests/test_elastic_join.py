@@ -1,0 +1,1047 @@
+"""Elastic scale-OUT suite (PR 16): live rank join, stripe rebalancing,
+autoscale, and join-churn chaos parity.
+
+Four layers, mirroring how the admission machinery can fail:
+
+* **Unit** (fast): join-request lifecycle (validity = unfenced + fresh
+  lease of the *same* incarnation), the roster roundtrip, the
+  ``assign_stripes`` rebalancing rule (home affinity, orphan spreading,
+  deterministic joiner steal), ``elect_members`` generalized with
+  ``joiners=`` (admission, joiner-death-fenced, member-death folded into
+  the retry, and the satellite join/fence same-epoch race), EpochTracker
+  join-vs-rejoin accounting, the lease-health telemetry
+  (``multihost_lease_renew_latency_seconds`` HDR +
+  ``multihost_lease_age_ratio`` gauge), and the autoscale supervisor
+  policy with injected observables.
+* **Admission protocol** (fast, in-process): ``FileLeaseTransport
+  .maybe_admit`` driven single-threaded against pre-posted join requests /
+  echo proposals (solo-gang admission, union-allgather admission with a
+  member death folded in, fenced-joiner proceeds-un-grown), and the
+  joiner-side ``request_admission`` echo loop (thread-driven success,
+  fenced, and timeout verdicts).
+* **2-process chaos** (slow): a third rank joins an ``--elastic`` run
+  mid-flight, adopts part of a stripe via the rebalance, and the merged
+  outputs are byte-identical to a fault-free single-host reference with
+  ``multihost_rank_joins_total == 1`` and the epoch bump in the merged
+  run report; join-churn: the joiner SIGKILL'd mid-window (survivors
+  re-adopt at the committed cursor — zero replay) and the joiner killed
+  mid-admission by an armed ``multihost.join.post`` fault (the gang
+  proceeds un-grown, still byte-identical).
+* **Autoscale smoke** (slow): ``--autoscale`` spawns a joiner under
+  sustained backlog, the joiner drains at idle, and the outputs match a
+  static-gang run byte-for-byte.
+
+The spawn helpers are standalone copies of tests/test_multihost_chaos.py's
+(same env contract: forced CPU platform, 4 forced devices per process) —
+importing across test modules would couple the suites' lifecycles.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import select
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from textblaster_tpu.data_model import TextDocument
+from textblaster_tpu.errors import (
+    GangReformed,
+    PipelineError,
+    ReformationFailed,
+)
+from textblaster_tpu.parallel import multihost
+from textblaster_tpu.parallel.autoscale import (
+    AutoscaleSupervisor,
+    parse_autoscale,
+)
+from textblaster_tpu.resilience import FAULTS
+from textblaster_tpu.resilience.membership import (
+    EpochTracker,
+    FileMembershipStore,
+    assign_stripes,
+    elect_members,
+    stripe_owner,
+)
+from textblaster_tpu.utils.metrics import (
+    METRICS,
+    latency_report,
+    metrics_snapshot,
+)
+
+pytestmark = pytest.mark.join
+
+REPO = Path(__file__).parent.parent
+
+YAML = """
+pipeline:
+  - type: LanguageDetectionFilter
+    min_confidence: 0.5
+    allowed_languages: [ "dan", "eng" ]
+  - type: GopherQualityFilter
+    min_doc_words: 4
+    min_stop_words: 1
+    stop_words: [ "og", "the", "er", "i" ]
+"""
+
+
+def _docs(n=48):
+    base = [
+        "Det er en god dag i dag, og vi skal ud at gå en lang tur i skoven nu.",
+        "The quick brown fox jumps over the lazy dog and the old stone bridge.",
+        "kort.",
+        "Endnu en dansk tekst om vejret, og den er ganske lang og fin at læse.",
+        "Vi mødes nede ved havnen i morgen, og så sejler vi ud på vandet.",
+        ("En meget lang dansk tekst om byen og havnen og vejret, og den "
+         "bliver ved i mange ord. ") * 12,
+    ]
+    rng = np.random.default_rng(23)
+    docs = []
+    for i in range(n):
+        t = base[i % len(base)]
+        if rng.random() < 0.25:
+            t = t + " Og lidt mere tekst til sidst her."
+        docs.append(TextDocument(id=f"ej-{i}", source="s", content=t))
+    return docs
+
+
+# --- join requests -----------------------------------------------------------
+
+
+def test_join_request_lifecycle(tmp_path):
+    root = str(tmp_path / "m")
+    s0 = FileMembershipStore(root, 0, ttl_s=5.0)
+    s2 = FileMembershipStore(root, 2, ttl_s=5.0)
+    s0.register()
+    s2.register()
+    before = METRICS.get("multihost_join_requests_total")
+    s2.post_join_request()
+    assert METRICS.get("multihost_join_requests_total") - before == 1
+    reqs = s0.read_join_requests()
+    assert set(reqs) == {2}
+    assert reqs[2]["incarnation"] == s2.incarnation
+    # A stale lease makes the request invisible — a joiner that died after
+    # posting simply stops being a candidate.
+    assert s0.read_join_requests(now=time.time() + 10.0) == {}
+    # A successor incarnation's lease does NOT validate the predecessor's
+    # request: the incarnation stamp must match the live lease.
+    s2b = FileMembershipStore(root, 2, ttl_s=5.0)
+    s2b.register()
+    assert s0.read_join_requests() == {}
+    s2b.post_join_request()
+    assert set(s0.read_join_requests()) == {2}
+    # Fencing the poster's incarnation invalidates the request.
+    s0.fence_rank(2)
+    assert s0.read_join_requests() == {}
+    s0.clear_join_request(2)
+    assert not os.path.exists(
+        os.path.join(root, "join", "rank2.json")
+    )
+    s0.clear_join_request(2)  # idempotent on a missing file
+
+
+def test_join_post_fault_site(tmp_path):
+    store = FileMembershipStore(str(tmp_path / "m"), 3, ttl_s=5.0)
+    store.register()
+    FAULTS.inject("multihost.join.post", OSError("injected join outage"))
+    try:
+        with pytest.raises(OSError):
+            store.post_join_request()
+    finally:
+        FAULTS.reset()
+    store.post_join_request()  # disarmed: the request lands
+
+
+def test_roster_roundtrip(tmp_path):
+    store = FileMembershipStore(str(tmp_path / "m"), 0, ttl_s=5.0)
+    store.register()
+    assert store.read_roster() is None
+    store.write_roster([1, 0, 2], membership_epoch=3, exchange_epoch=2)
+    roster = store.read_roster()
+    assert roster["members"] == [0, 1, 2]
+    assert roster["membership_epoch"] == 3
+    assert roster["exchange_epoch"] == 2
+    assert roster["by"] == 0
+
+
+# --- lease-health telemetry (satellite: renew latency HDR + age gauge) -------
+
+
+def test_lease_renewal_records_latency_and_age_ratio(tmp_path):
+    store = FileMembershipStore(str(tmp_path / "m"), 0, ttl_s=10.0)
+    before = metrics_snapshot()
+    store.register()
+    store.post()
+    after = metrics_snapshot()
+    fam = "multihost_lease_renew_latency_seconds"
+    assert after.get(f"{fam}::count", 0.0) - before.get(
+        f"{fam}::count", 0.0
+    ) >= 2.0
+    # A just-renewed lease sits at the young end of its TTL.
+    assert store.my_lease_fresh()
+    ratio = METRICS.get("multihost_lease_age_ratio")
+    assert 0.0 <= ratio < 0.5
+    # The family surfaces as a stage in the run report's latency section.
+    stages = latency_report(baseline=before, values=after)["stages"]
+    assert stages["lease_renew"]["count"] >= 2
+
+
+# --- assign_stripes ----------------------------------------------------------
+
+
+def test_assign_stripes_home_affinity_and_orphans():
+    # Fixed gang: degenerates to per-stripe stripe_owner.
+    assert assign_stripes([0, 1], [0, 1], 2) == {0: 0, 1: 1}
+    assert assign_stripes([0, 1], [0], 2) == {0: 0, 1: 0}
+    assert assign_stripes([0, 1], [1], 2) == {0: 1, 1: 1}
+    assert assign_stripes([0, 1], [], 2) == {0: None, 1: None}
+    for live in ([0, 1], [0], [1]):
+        got = assign_stripes([0, 1], live, 2)
+        for s in (0, 1):
+            assert got[s] == stripe_owner(s, live)
+    # Orphans spread to the least-loaded live rank (ties -> lowest rank),
+    # not all onto one survivor.
+    assert assign_stripes([0, 1, 2], [0, 1], 3) == {0: 0, 1: 1, 2: 0}
+    assert assign_stripes([1, 2], [0, 1], 3) == {1: 1, 2: 0}
+
+
+def test_assign_stripes_joiner_rebalance_is_deterministic():
+    # One idle joiner steals the most-loaded donor's highest stripe.
+    assert assign_stripes([0, 1], [0, 1, 2], 2) == {0: 0, 1: 2}
+    # Two idle joiners: rank 2 takes the higher donor's stripe first, then
+    # rank 3 takes the remaining unstolen one — never re-stealing.
+    assert assign_stripes([0, 1], [0, 1, 2, 3], 2) == {0: 3, 1: 2}
+    # A busy joiner (it got an orphan) does not steal again.
+    got = assign_stripes([0, 1, 2], [0, 2, 3], 3)
+    assert got[0] == 0 and got[2] == 2
+    assert got[1] in (0, 2, 3)
+    # Pure function: identical inputs (in any order) -> identical output.
+    a = assign_stripes([1, 0], [2, 0, 1], 2)
+    b = assign_stripes([0, 1], [0, 1, 2], 2)
+    assert a == b
+
+
+# --- elect_members with joiners ----------------------------------------------
+
+
+def test_elect_members_admits_joiner(tmp_path):
+    root = str(tmp_path / "m")
+    s0 = FileMembershipStore(root, 0, ttl_s=30.0)
+    s1 = FileMembershipStore(root, 1, ttl_s=30.0)
+    s0.register()
+    s1.register()
+    # The joiner echoes (as request_admission would): its attempt-0
+    # proposal is already posted.
+    s1.post_proposal("adm.a0", [0, 1])
+    members, newly_dead = elect_members(
+        s0, [0], [], tag="adm", deadline_s=2.0, joiners=[1]
+    )
+    assert members == (0, 1)
+    assert newly_dead == ()  # admission, not reformation
+
+
+def test_elect_members_joiner_death_is_fenced_not_reported_dead(tmp_path):
+    root = str(tmp_path / "m")
+    s0 = FileMembershipStore(root, 0, ttl_s=30.0)
+    s1 = FileMembershipStore(root, 1, ttl_s=30.0)
+    s0.register()
+    s1.register()
+    # The joiner never proposes: attempt 0 times out on it, attempt 1
+    # fences it — the gang proceeds un-grown with an empty newly_dead
+    # (the joiner was never a member).
+    members, newly_dead = elect_members(
+        s0, [0], [], tag="dj", deadline_s=0.3, joiners=[1]
+    )
+    assert members == (0,)
+    assert newly_dead == ()
+    assert s0.is_fenced(1, s1.incarnation)
+
+
+def test_elect_members_member_death_folds_into_admission(tmp_path):
+    root = str(tmp_path / "m")
+    s0 = FileMembershipStore(root, 0, ttl_s=30.0)
+    s1 = FileMembershipStore(root, 1, ttl_s=30.0)
+    s2 = FileMembershipStore(root, 2, ttl_s=30.0)
+    s0.register()
+    s1.register()
+    s2.register()
+    # Joiner 2 echoes both attempts; member 1 is silent (died during the
+    # admission sweep) — the election retries with 1 suspected and elects
+    # the grown-minus-dead set in one pass.
+    s2.post_proposal("ma.a0", [0, 1, 2])
+    s2.post_proposal("ma.a1", [0, 2])
+    members, newly_dead = elect_members(
+        s0, [0, 1], [], tag="ma", deadline_s=0.3, joiners=[2]
+    )
+    assert members == (0, 2)
+    assert newly_dead == (1,)  # the member death IS reported
+
+
+def test_join_and_fence_race_in_same_epoch_is_deterministic(tmp_path):
+    """Satellite: a join request and a fence racing in the same epoch.
+    Rank 1 saw joiner 3's request before electing; rank 0 did not.  Both
+    must converge on the identical member set — the joiner is adopted
+    from the disagreeing proposal (never suspected for being unknown) and
+    only the fenced member 2 is reported dead."""
+    root = str(tmp_path / "m")
+    s0 = FileMembershipStore(root, 0, ttl_s=30.0)
+    s1 = FileMembershipStore(root, 1, ttl_s=30.0)
+    s3 = FileMembershipStore(root, 3, ttl_s=30.0)
+    s0.register()
+    s1.register()
+    s3.register()
+    # Rank 1 already fenced the dead member and proposed with the joiner
+    # included; the joiner echoes every attempt it appears in.
+    s1.fence_rank(2)
+    s1.post_proposal("race.a0", [0, 1, 3])
+    s1.post_proposal("race.a1", [0, 1, 3])
+    s3.post_proposal("race.a0", [0, 1, 3])
+    s3.post_proposal("race.a1", [0, 1, 3])
+    # Rank 0 starts blind to the join request (joiners=()): attempt 0
+    # disagrees, it adopts the joiner from rank 1's proposal, attempt 1
+    # converges.
+    m0, dead0 = elect_members(
+        s0, [0, 1, 2], [2], tag="race", deadline_s=2.0
+    )
+    # Rank 1 runs the same election having seen the request first-hand.
+    m1, dead1 = elect_members(
+        s1, [0, 1, 2], [2], tag="race", deadline_s=2.0, joiners=[3]
+    )
+    assert m0 == m1 == (0, 1, 3)
+    assert dead0 == dead1 == (2,)
+    assert 3 not in dead0  # a joiner is never reported newly-dead
+
+
+# --- EpochTracker join accounting --------------------------------------------
+
+
+def test_epoch_tracker_counts_joins_once_across_the_gang():
+    joins0 = METRICS.get("multihost_rank_joins_total")
+    t0 = EpochTracker(0)
+    t1 = EpochTracker(1)
+    t0.observe([0, 1])
+    t1.observe([0, 1])
+    ev = t0.observe([0, 1, 2])
+    assert t0.epoch == 2
+    assert any("rank 2 joined the gang" in m for m in ev)
+    # Only the lowest rank of the previous live set counts the join...
+    assert METRICS.get("multihost_rank_joins_total") - joins0 == 1
+    ev1 = t1.observe([0, 1, 2])
+    assert any("rank 2 joined the gang" in m for m in ev1)
+    # ...so a second member observing the same join adds nothing.
+    assert METRICS.get("multihost_rank_joins_total") - joins0 == 1
+    # The joiner's own tracker baselines with itself included: it never
+    # counts its own admission.
+    t2 = EpochTracker(2)
+    t2.observe([0, 1, 2])
+    assert METRICS.get("multihost_rank_joins_total") - joins0 == 1
+    # Dropping out and coming back is a REJOIN, not a join.
+    t0.observe([0, 1])
+    ev = t0.observe([0, 1, 2])
+    assert any("rejoined" in m for m in ev)
+    assert METRICS.get("multihost_rank_joins_total") - joins0 == 1
+
+
+# --- transport admission sweep (maybe_admit) ---------------------------------
+
+
+@pytest.fixture()
+def _exchange_state():
+    multihost.configure_exchange(deadline_s=300.0, reset=True)
+    yield multihost._EXCHANGE
+    multihost.configure_exchange(deadline_s=300.0, reset=True)
+
+
+def test_maybe_admit_solo_gang_admits_and_raises(tmp_path, _exchange_state):
+    """Solo gang + pre-posted join request and echo proposal: the phase-
+    boundary sweep must admit the joiner, bump both epochs, publish the
+    roster, clear the request, and raise GangReformed into the driver."""
+    root = str(tmp_path / "membership")
+    s0 = FileMembershipStore(root, 0, ttl_s=30.0)
+    s1 = FileMembershipStore(root, 1, ttl_s=30.0)
+    s0.register()
+    s1.register()
+    s1.post_join_request()
+    s1.post_proposal("join.e0.a0", [0, 1])  # the echo
+    ft = multihost.FileLeaseTransport(s0, 0, 1, survive=True)
+    multihost.configure_exchange(
+        deadline_s=2.0, lease_store=s0, transport=ft
+    )
+    joins_before = METRICS.get("multihost_rank_joins_total")
+    with pytest.raises(GangReformed) as ei:
+        multihost.maybe_admit_joiners()
+    assert tuple(ei.value.members) == (0, 1)
+    assert tuple(ei.value.dead_ranks) == ()
+    assert ft.members() == (0, 1)
+    assert ft.reformations == 0  # admission is not a reformation
+    assert multihost.current_exchange_epoch() == 1
+    roster = s0.read_roster()
+    assert roster["members"] == [0, 1]
+    assert roster["exchange_epoch"] == 1
+    assert s0.read_join_requests() == {}  # handled
+    assert METRICS.get("multihost_rank_joins_total") - joins_before == 1
+
+
+def test_maybe_admit_union_allgather_with_member_death(
+    tmp_path, _exchange_state
+):
+    """Two members, one joiner: the sweep allgathers the locally observed
+    join ranks first (either every member admits or none does).  Member 1
+    posted its union row, then died before proposing — the admission
+    election folds that into a reformation retry: joiner admitted AND the
+    dead member evicted, in one epoch bump."""
+    root = str(tmp_path / "membership")
+    s0 = FileMembershipStore(root, 0, ttl_s=30.0)
+    s1 = FileMembershipStore(root, 1, ttl_s=30.0)
+    s2 = FileMembershipStore(root, 2, ttl_s=30.0)
+    s0.register()
+    s1.register()
+    s2.register()
+    s2.post_join_request()
+    # Rank 1's union-allgather row (it saw the same joiner), pre-posted.
+    s1.post_exchange_slot(0, 0, "2,-1,-1,-1")
+    # The joiner echoes both attempts; rank 1 proposes neither (dead).
+    s2.post_proposal("join.e0.a0", [0, 1, 2])
+    s2.post_proposal("join.e0.a1", [0, 2])
+    ft = multihost.FileLeaseTransport(s0, 0, 2, survive=True)
+    multihost.configure_exchange(
+        deadline_s=0.5, lease_store=s0, transport=ft
+    )
+    reforms_before = METRICS.get("multihost_gang_reformations_total")
+    with pytest.raises(GangReformed) as ei:
+        ft.maybe_admit()
+    assert tuple(ei.value.members) == (0, 2)
+    assert tuple(ei.value.dead_ranks) == (1,)
+    assert ft.members() == (0, 2)
+    assert ft.dead_ranks == [1]
+    assert ft.reformations == 1  # the member death counts as one
+    assert (
+        METRICS.get("multihost_gang_reformations_total") - reforms_before
+        == 1
+    )
+    assert s0.read_roster()["members"] == [0, 2]
+
+
+def test_maybe_admit_fenced_joiner_proceeds_ungrown(
+    tmp_path, _exchange_state, capsys
+):
+    root = str(tmp_path / "membership")
+    s0 = FileMembershipStore(root, 0, ttl_s=30.0)
+    s1 = FileMembershipStore(root, 1, ttl_s=30.0)
+    s0.register()
+    s1.register()
+    s1.post_join_request()  # ...and then the joiner dies: no echo, ever.
+    ft = multihost.FileLeaseTransport(s0, 0, 1, survive=True)
+    multihost.configure_exchange(
+        deadline_s=0.3, lease_store=s0, transport=ft
+    )
+    ft.maybe_admit()  # no raise: the gang proceeds un-grown
+    assert ft.members() == (0,)
+    assert s0.is_fenced(1, s1.incarnation)
+    assert s0.read_join_requests() == {}  # the dead request is cleared
+    assert "proceeds un-grown" in capsys.readouterr().out
+    # The next boundary's sweep is a clean no-op (nothing re-triggers).
+    ft.maybe_admit()
+    assert ft.members() == (0,)
+
+
+def test_maybe_admit_is_noop_without_survive_or_requests(
+    tmp_path, _exchange_state
+):
+    # No transport installed (kv path): the phase-boundary hook is inert.
+    multihost.maybe_admit_joiners()
+    root = str(tmp_path / "membership")
+    s0 = FileMembershipStore(root, 0, ttl_s=30.0)
+    s0.register()
+    # survive=False: admission is a survive-mode feature.
+    ft = multihost.FileLeaseTransport(s0, 0, 1, survive=False)
+    multihost.configure_exchange(
+        deadline_s=1.0, lease_store=s0, transport=ft
+    )
+    ft.maybe_admit()
+    assert ft.members() == (0,)
+    # survive=True but no requests posted: still a no-op.
+    ft2 = multihost.FileLeaseTransport(s0, 0, 1, survive=True)
+    multihost.configure_exchange(
+        deadline_s=1.0, lease_store=s0, transport=ft2
+    )
+    ft2.maybe_admit()
+    assert ft2.members() == (0,)
+    assert multihost.current_exchange_epoch() == 0  # nothing bumped
+
+
+def test_join_admit_fault_site_is_armable(tmp_path, _exchange_state):
+    root = str(tmp_path / "membership")
+    s0 = FileMembershipStore(root, 0, ttl_s=30.0)
+    s1 = FileMembershipStore(root, 1, ttl_s=30.0)
+    s0.register()
+    s1.register()
+    s1.post_join_request()
+    ft = multihost.FileLeaseTransport(s0, 0, 1, survive=True)
+    multihost.configure_exchange(
+        deadline_s=1.0, lease_store=s0, transport=ft
+    )
+    FAULTS.inject("multihost.join.admit", OSError("injected admit outage"))
+    try:
+        with pytest.raises(OSError):
+            ft.maybe_admit()
+    finally:
+        FAULTS.reset()
+
+
+# --- joiner-side request_admission -------------------------------------------
+
+
+def test_request_admission_echoes_and_learns_roster(tmp_path):
+    root = str(tmp_path / "m")
+    s0 = FileMembershipStore(root, 0, ttl_s=30.0)
+    s1 = FileMembershipStore(root, 1, ttl_s=30.0)
+    s0.register()
+    s1.register()
+    result: dict = {}
+
+    def joiner():
+        try:
+            result["roster"] = multihost.request_admission(
+                s1, deadline_s=10.0, poll_s=0.02
+            )
+        except Exception as e:  # noqa: BLE001 — surfaced by the assert
+            result["error"] = e
+
+    th = threading.Thread(target=joiner, daemon=True)
+    th.start()
+    # Gang side: observe the request, run the admission election (the
+    # joiner's echo loop makes it a unanimous candidate), publish.
+    deadline = time.monotonic() + 5.0
+    while not s0.read_join_requests() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert set(s0.read_join_requests()) == {1}
+    members, newly_dead = elect_members(
+        s0, [0], [], tag="join.e0", deadline_s=5.0, joiners=[1]
+    )
+    assert members == (0, 1) and newly_dead == ()
+    s0.write_roster(members, membership_epoch=2, exchange_epoch=1)
+    th.join(timeout=10.0)
+    assert "error" not in result, result.get("error")
+    roster = result["roster"]
+    assert roster["members"] == [0, 1]
+    assert roster["exchange_epoch"] == 1  # the joiner aligns to this
+
+
+def test_request_admission_fenced_raises_typed(tmp_path):
+    root = str(tmp_path / "m")
+    s0 = FileMembershipStore(root, 0, ttl_s=30.0)
+    s1 = FileMembershipStore(root, 1, ttl_s=30.0)
+    s0.register()
+    s1.register()
+    s0.fence_rank(1)  # the gang's died-mid-admission verdict
+    with pytest.raises(ReformationFailed) as ei:
+        multihost.request_admission(s1, deadline_s=2.0, poll_s=0.02)
+    assert "un-grown" in str(ei.value)
+
+
+def test_request_admission_times_out_typed(tmp_path):
+    store = FileMembershipStore(str(tmp_path / "m"), 1, ttl_s=30.0)
+    store.register()
+    with pytest.raises(ReformationFailed) as ei:
+        multihost.request_admission(store, deadline_s=0.2, poll_s=0.02)
+    assert "not admitted within" in str(ei.value)
+
+
+# --- autoscale supervisor ----------------------------------------------------
+
+
+class _FakeProc:
+    def __init__(self, pid=4242):
+        self.pid = pid
+        self.code = None
+
+    def poll(self):
+        return self.code
+
+    def wait(self, timeout=None):
+        if self.code is None:
+            raise subprocess.TimeoutExpired("fake", timeout)
+        return self.code
+
+
+def test_parse_autoscale_validation():
+    assert parse_autoscale("1:2", 1) == (1, 2)
+    assert parse_autoscale("2:4", 2) == (2, 4)
+    with pytest.raises(PipelineError, match="MIN:MAX"):
+        parse_autoscale("3", 1)
+    with pytest.raises(PipelineError, match="MIN:MAX"):
+        parse_autoscale("a:b", 1)
+    with pytest.raises(PipelineError, match="1 <= MIN <= MAX"):
+        parse_autoscale("0:2", 1)
+    with pytest.raises(PipelineError, match="1 <= MIN <= MAX"):
+        parse_autoscale("3:2", 1)
+    with pytest.raises(PipelineError, match="must exceed the stripe count"):
+        parse_autoscale("2:2", 2)
+
+
+def _supervisor(
+    *, rank=0, num_stripes=1, spec="1:2", live=None, backlog=None,
+    sustain=2
+):
+    live_box = {"v": live if live is not None else [0]}
+    backlog_box = {"v": backlog if backlog is not None else 100}
+    spawned = []
+
+    def spawn_fn(cmd):
+        p = _FakeProc(pid=9000 + len(spawned))
+        spawned.append((cmd, p))
+        return p
+
+    said = []
+    sup = AutoscaleSupervisor(
+        spec,
+        num_stripes=num_stripes,
+        rank=rank,
+        live_ranks=lambda: live_box["v"],
+        backlog_rows=lambda: backlog_box["v"],
+        spawn_command=lambda jid: ["run-joiner", str(jid)],
+        say=said.append,
+        sustain=sustain,
+        spawn_fn=spawn_fn,
+    )
+    return sup, live_box, backlog_box, spawned, said
+
+
+def test_supervisor_spawns_after_sustained_backlog():
+    sup, live, _backlog, spawned, said = _supervisor()
+    before = METRICS.get("multihost_autoscale_spawned_total")
+    sup.tick()  # streak 1: one slow tick is not a scale-out signal
+    assert spawned == []
+    sup.tick()  # streak 2: spawn
+    assert len(spawned) == 1
+    assert spawned[0][0] == ["run-joiner", "1"]
+    assert sup.spawned_total == 1
+    assert METRICS.get("multihost_autoscale_spawned_total") - before == 1
+    assert any("spawned joiner rank 1" in m for m in said)
+    # The only joiner id is taken (child alive): no second spawn even
+    # under continued backlog.
+    sup.tick()
+    sup.tick()
+    assert len(spawned) == 1
+    # The child exits (drained); an idle tick reaps it and resets the
+    # streak, then a fresh sustained backlog restarts the cycle.
+    spawned[0][1].code = 0
+    _backlog["v"] = 0
+    sup.tick()
+    assert 1 not in sup.children
+    assert any("exited" in m for m in said)
+    _backlog["v"] = 50
+    sup.tick()
+    assert len(spawned) == 1  # streak 1 again: not yet
+    sup.tick()  # streak 2: respawn
+    assert len(spawned) == 2
+
+
+def test_supervisor_duty_follows_lowest_live_home_rank():
+    sup, live, backlog, spawned, _said = _supervisor(
+        rank=1, num_stripes=2, spec="2:3", live=[0, 1]
+    )
+    sup.tick()
+    sup.tick()
+    assert spawned == []  # rank 0 holds duty while live
+    live["v"] = [1]  # rank 0 died: duty fails over to rank 1
+    sup.tick()
+    sup.tick()
+    assert len(spawned) == 1 and spawned[0][0] == ["run-joiner", "2"]
+
+
+def test_supervisor_respects_max_and_idle():
+    sup, live, backlog, spawned, _said = _supervisor(
+        rank=0, num_stripes=2, spec="2:3", live=[0, 1, 2]
+    )
+    sup.tick()
+    sup.tick()
+    assert spawned == []  # at MAX workers already
+    live["v"] = [0, 1]
+    backlog["v"] = 0
+    sup.tick()
+    sup.tick()
+    assert spawned == []  # idle: the streak never starts
+    backlog["v"] = 7
+    sup.tick()
+    backlog["v"] = 0
+    sup.tick()  # a break in the backlog resets the streak
+    backlog["v"] = 7
+    sup.tick()
+    assert spawned == []
+    sup.tick()
+    assert len(spawned) == 1
+
+
+def test_supervisor_drain_is_best_effort():
+    sup, _live, _backlog, spawned, said = _supervisor()
+    sup.tick()
+    sup.tick()
+    assert len(spawned) == 1
+    sup.drain(timeout_s=0.05)  # child never exits: drain must not raise
+    assert any("still running" in m for m in said)
+    spawned[0][1].code = 0
+    sup.drain(timeout_s=0.05)
+    assert sup.children == {}
+
+
+# --- 2-process chaos ---------------------------------------------------------
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_rank(tmp_path, pid, port, extra_args=(), num_processes=2,
+                env_extra=None):
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        "PATH": "/usr/bin:/bin:/usr/local/bin",
+        "HOME": "/root",
+    }
+    if env_extra:
+        env.update(env_extra)
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "textblaster_tpu.cli", "run",
+            "--coordinator", f"localhost:{port}",
+            "--num-processes", str(num_processes),
+            "--process-id", str(pid),
+            "-i", str(tmp_path / "input.parquet"),
+            "-o", str(tmp_path / "kept.parquet"),
+            "-e", str(tmp_path / "excluded.parquet"),
+            "-c", str(tmp_path / "cfg.yaml"),
+            "--buckets", "512,2048",
+            "--quiet",
+            *extra_args,
+        ],
+        cwd=str(REPO),
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+
+def _read_until(proc, pattern, timeout, sink):
+    rx = re.compile(pattern)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        r, _, _ = select.select([proc.stdout], [], [], 0.5)
+        if not r:
+            if proc.poll() is not None:
+                return None
+            continue
+        line = proc.stdout.readline()
+        if not line:
+            return None
+        sink.append(line)
+        m = rx.search(line)
+        if m:
+            return m
+    return None
+
+
+def _drain(proc, sink, timeout):
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+    if out:
+        sink.append(out)
+    return "".join(sink)
+
+
+def _write_input(dirpath, docs):
+    inp = dirpath / "input.parquet"
+    pq.write_table(
+        pa.table(
+            {
+                "id": [d.id for d in docs],
+                "text": [d.content for d in docs],
+                "source": [d.source for d in docs],
+            }
+        ),
+        inp,
+    )
+    return inp
+
+
+def _rows(path):
+    return {
+        r["id"]: (
+            r["text"],
+            json.loads(r["metadata"]) if r["metadata"] else {},
+        )
+        for r in pq.read_table(path).to_pylist()
+    }
+
+
+def _single_host_reference(tmp_path, docs):
+    ref = tmp_path / "ref"
+    ref.mkdir()
+    (ref / "cfg.yaml").write_text(YAML, encoding="utf-8")
+    _write_input(ref, docs)
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "textblaster_tpu.cli", "run",
+            "-i", str(ref / "input.parquet"),
+            "-o", str(ref / "kept.parquet"),
+            "-e", str(ref / "excluded.parquet"),
+            "-c", str(ref / "cfg.yaml"),
+            "--buckets", "512,2048",
+            "--quiet",
+        ],
+        cwd=str(REPO),
+        env={
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "PATH": "/usr/bin:/bin:/usr/local/bin",
+            "HOME": "/root",
+        },
+        capture_output=True, text=True, timeout=560,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    return ref / "kept.parquet", ref / "excluded.parquet"
+
+
+ELASTIC_ARGS = ("--elastic", "--lease-ttl-s", "3", "--batch-size", "8")
+
+
+def _assert_parity(tmp_path, docs):
+    ref_out, ref_exc = _single_host_reference(tmp_path, docs)
+    assert _rows(tmp_path / "kept.parquet") == _rows(ref_out)
+    assert _rows(tmp_path / "excluded.parquet") == _rows(ref_exc)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_elastic_join_mid_run_adopts_stripe_and_matches_single_host(tmp_path):
+    """The ISSUE acceptance scenario: a third rank joins a 2-stripe
+    ``--elastic`` run mid-flight, is admitted off its join request, and
+    the rebalance hands it part of a stripe (donor fences at a committed
+    chunk, joiner adopts the cursor).  Merged outputs must be
+    byte-identical to a fault-free single-host run, with exactly one
+    counted join and the membership-epoch bump in the merged report.
+
+    The doc count is sized so each home stripe outlasts the joiner's cold
+    start (imports + jit compile) by a wide margin — a joiner that arrives
+    after the merge finds no live gang and exits without work, which the
+    harness reports as a skip, not a pass."""
+    docs = _docs(1536)
+    (tmp_path / "cfg.yaml").write_text(YAML, encoding="utf-8")
+    _write_input(tmp_path, docs)
+    port = _free_port()
+    args = ELASTIC_ARGS + ("--run-report", str(tmp_path / "report.json"),)
+    p0 = _spawn_rank(tmp_path, 0, port, args)
+    p1 = _spawn_rank(tmp_path, 1, port, args)
+    sink0, sink1, sink2 = [], [], []
+    p2 = None
+    try:
+        # Let the gang get to work, then launch the joiner.
+        m = _read_until(
+            p0, r"stripe \d+ committed rows \d+/\d+", timeout=420,
+            sink=sink0,
+        )
+        if m is None:
+            pytest.skip(
+                "rank 0 finished before the joiner could be launched:\n"
+                + "".join(sink0)[-1500:]
+            )
+        p2 = _spawn_rank(tmp_path, 2, port, args)
+        m = _read_until(
+            p2, r"adopted stripe (\d+) at row (\d+)/(\d+)", timeout=420,
+            sink=sink2,
+        )
+        if m is None:
+            pytest.skip(
+                "stripes completed before the joiner could adopt one:\n"
+                + "".join(sink2)[-1500:]
+            )
+        out0 = _drain(p0, sink0, timeout=420)
+        out1 = _drain(p1, sink1, timeout=420)
+        out2 = _drain(p2, sink2, timeout=420)
+        assert p0.returncode == 0, out0[-3000:]
+        assert p1.returncode == 0, out1[-3000:]
+        assert p2.returncode == 0, out2[-3000:]
+    finally:
+        for p in (p0, p1, p2):
+            if p is not None and p.poll() is None:
+                p.kill()
+
+    assert "posted join request" in out2
+    # A gang member observed and admitted the valid request.
+    assert "admitting joiner rank 2" in out0 + out1
+    # The donor discovered the steal at a committed boundary, not mid-chunk.
+    assert "lost to another owner" in out0 + out1
+    assert "join(s)" in out0 + out1 + out2  # CLI churn summary names joins
+
+    report = json.loads(
+        (tmp_path / "report.json").read_text(encoding="utf-8")
+    )
+    res = report["resilience"]
+    assert res["multihost_rank_joins_total"] == 1
+    assert res["multihost_join_requests_total"] == 1
+    assert res["multihost_membership_epoch"] >= 2  # the admission bump
+    assert report["num_hosts"] == 3  # every rank posted a report shard
+    assert report["counts"]["received"] == len(docs)
+
+    _assert_parity(tmp_path, docs)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_elastic_joiner_sigkilled_mid_window_zero_replay(tmp_path):
+    """Join-churn: the joiner adopts a stripe, commits at least one chunk,
+    and is SIGKILL'd.  The home ranks must evict it within the lease TTL,
+    re-adopt the stripe at (or past) the committed cursor — zero replayed
+    chunks — and finish byte-identical to the single-host reference."""
+    docs = _docs(1536)
+    (tmp_path / "cfg.yaml").write_text(YAML, encoding="utf-8")
+    _write_input(tmp_path, docs)
+    port = _free_port()
+    p0 = _spawn_rank(tmp_path, 0, port, ELASTIC_ARGS)
+    p1 = _spawn_rank(tmp_path, 1, port, ELASTIC_ARGS)
+    sink0, sink1, sink2 = [], [], []
+    p2 = None
+    try:
+        m = _read_until(
+            p0, r"stripe \d+ committed rows \d+/\d+", timeout=420,
+            sink=sink0,
+        )
+        if m is None:
+            pytest.skip(
+                "rank 0 finished before the joiner could be launched:\n"
+                + "".join(sink0)[-1500:]
+            )
+        p2 = _spawn_rank(tmp_path, 2, port, ELASTIC_ARGS)
+        # Kill only after the joiner owns work AND committed a chunk on it.
+        m = _read_until(
+            p2, r"stripe (\d+) committed rows (\d+)/(\d+)", timeout=420,
+            sink=sink2,
+        )
+        if m is None:
+            pytest.skip(
+                "the joiner never committed a chunk before completion:\n"
+                + "".join(sink2)[-1500:]
+            )
+        stripe, committed = int(m.group(1)), int(m.group(2))
+        if committed >= int(m.group(3)):
+            pytest.skip("the stolen stripe completed in the first commit")
+        os.kill(p2.pid, signal.SIGKILL)
+        out0 = _drain(p0, sink0, timeout=420)
+        out1 = _drain(p1, sink1, timeout=420)
+        assert p0.returncode == 0, out0[-3000:]
+        assert p1.returncode == 0, out1[-3000:]
+    finally:
+        for p in (p0, p1, p2):
+            if p is not None and p.poll() is None:
+                p.kill()
+        if p2 is not None:
+            _drain(p2, sink2, timeout=30)
+
+    survivors = out0 + out1
+    assert "evicted rank 2" in survivors
+    # The stripe's home rank re-claims it as a "resume" (home affinity
+    # puts the orphan back where it lived); any other survivor "adopts".
+    adopted = re.search(
+        rf"(?:adopted stripe {stripe}|stripe {stripe} resume) "
+        rf"at row (\d+)/",
+        survivors,
+    )
+    assert adopted is not None, survivors[-3000:]
+    # Zero replayed committed chunks: re-adoption resumed at or past the
+    # joiner's committed cursor.
+    assert int(adopted.group(1)) >= committed
+    _assert_parity(tmp_path, docs)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_elastic_joiner_killed_mid_admission_gang_proceeds_ungrown(tmp_path):
+    """Join-churn, deterministic twin: the joiner dies of an armed
+    ``multihost.join.post`` fault before its request lands.  The gang
+    never sees a valid request, assigns it nothing, and finishes as a
+    2-rank run — byte-identical to the single-host reference."""
+    docs = _docs(128)
+    (tmp_path / "cfg.yaml").write_text(YAML, encoding="utf-8")
+    _write_input(tmp_path, docs)
+    port = _free_port()
+    p0 = _spawn_rank(tmp_path, 0, port, ELASTIC_ARGS)
+    p1 = _spawn_rank(tmp_path, 1, port, ELASTIC_ARGS)
+    p2 = _spawn_rank(
+        tmp_path, 2, port, ELASTIC_ARGS,
+        env_extra={
+            "TEXTBLAST_FAULTS": "multihost.join.post",
+            "TEXTBLAST_FAULTS_PROCESS": "2",
+        },
+    )
+    sink0, sink1, sink2 = [], [], []
+    try:
+        out2 = _drain(p2, sink2, timeout=120)
+        assert p2.returncode != 0, out2[-2000:]  # the joiner died
+        assert "injected fault at multihost.join.post" in out2
+        out0 = _drain(p0, sink0, timeout=420)
+        out1 = _drain(p1, sink1, timeout=420)
+        assert p0.returncode == 0, out0[-3000:]
+        assert p1.returncode == 0, out1[-3000:]
+    finally:
+        for p in (p0, p1, p2):
+            if p.poll() is None:
+                p.kill()
+
+    survivors = out0 + out1
+    # No valid request ever existed: nothing was admitted or assigned.
+    assert "admitting joiner rank 2" not in survivors
+    assert "adopted stripe" not in out2
+    _assert_parity(tmp_path, docs)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_autoscale_spawns_joiner_under_backlog_and_drains(tmp_path):
+    """``--autoscale`` smoke: a single home rank under sustained backlog
+    must spawn at least one joiner (which steals pending work via the
+    rebalance), the joiner must drain at idle (fence-and-leave), and the
+    merged outputs must match a fault-free static run byte-for-byte."""
+    docs = _docs(768)
+    (tmp_path / "cfg.yaml").write_text(YAML, encoding="utf-8")
+    _write_input(tmp_path, docs)
+    port = _free_port()
+    p0 = _spawn_rank(
+        tmp_path, 0, port,
+        ("--elastic", "--lease-ttl-s", "3", "--batch-size", "4",
+         "--autoscale", "1:2"),
+        num_processes=1,
+    )
+    sink0 = []
+    try:
+        out0 = _drain(p0, sink0, timeout=560)
+        assert p0.returncode == 0, out0[-4000:]
+    finally:
+        if p0.poll() is None:
+            p0.kill()
+
+    assert "autoscale: spawned joiner rank 1" in out0
+    # The joiner exited on its own (drained at idle) or was reaped at the
+    # merge barrier; either way the supervisor accounted for it.
+    assert re.search(
+        r"autoscale: joiner rank 1 (exited|still running)", out0
+    ), out0[-3000:]
+    _assert_parity(tmp_path, docs)

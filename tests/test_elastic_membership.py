@@ -382,8 +382,40 @@ def test_elastic_rejects_collective_only_features(tmp_path):
             config, str(inp), str(tmp_path / "o.parquet"),
             str(tmp_path / "e.parquet"),
             coordinator="localhost:1", num_processes=2, process_id=0,
-            elastic=True, run_report=str(tmp_path / "r.json"),
+            elastic=True, auto_geometry=True,
         )
+    # --autoscale is an elastic-only feature in the other direction.
+    with pytest.raises(PipelineError, match="--autoscale requires --elastic"):
+        multihost.run_multihost(
+            config, str(inp), str(tmp_path / "o.parquet"),
+            str(tmp_path / "e.parquet"),
+            coordinator="localhost:1", num_processes=2, process_id=0,
+            autoscale="2:3",
+        )
+
+
+def test_elastic_solo_run_writes_merged_run_report(tmp_path):
+    """--elastic + --run-report (formerly rejected): the merging rank must
+    emit a v3 report folding every rank's shard — trivially its own here —
+    with exact merged counts."""
+    docs = _docs(16)
+    inp = _write_input(tmp_path, docs)
+    report = tmp_path / "report.json"
+    config = parse_pipeline_config(YAML)
+    result = multihost.run_multihost(
+        config, str(inp), str(tmp_path / "o.parquet"),
+        str(tmp_path / "e.parquet"),
+        coordinator="localhost:1", num_processes=1, process_id=0,
+        buckets=(512, 2048), read_batch_size=8,
+        elastic=True, lease_ttl_s=2.0,
+        run_report=str(report),
+        provenance={"pipeline_config": "inline"},
+    )
+    data = json.loads(report.read_text(encoding="utf-8"))
+    assert data["schema"] == "textblaster-run-report/v3"
+    assert data["counts"]["received"] == result.received == len(docs)
+    assert data["counts"]["success"] == result.success
+    assert len(data["hosts"]) == 1 and data["hosts"][0]["process"] == 0
 
 
 # --- subprocess: real coordination-service KV leases -------------------------

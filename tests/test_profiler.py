@@ -282,6 +282,31 @@ def test_sentinel_counts_check_passes_against_checked_in_baseline(tmp_path):
     assert "PASS" in proc.stdout
 
 
+def test_sentinel_counts_check_passes_with_join_modules_imported(tmp_path):
+    """The elastic scale-out layer (admission transport hooks, the
+    autoscale supervisor, the membership join/roster API) must be inert at
+    import time: loading it before the sentinel runs must not change the
+    program set or dispatch counts the baseline pins."""
+    script = (
+        "import textblaster_tpu.parallel.multihost\n"
+        "import textblaster_tpu.parallel.autoscale\n"
+        "import textblaster_tpu.resilience.membership\n"
+        "import sys\n"
+        "from textblaster_tpu.utils.profiler import main\n"
+        f"sys.exit(main(['--check', {BASELINE!r}, '--counts-only']))\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        env=_clean_env(TEXTBLAST_AOT_CACHE_DIR=str(tmp_path / "aot")),
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PASS" in proc.stdout
+
+
 def test_sentinel_check_fails_on_depfuse_off(tmp_path):
     """A flipped fusion hatch must fail the check, naming the drifted
     (bucket, phase) entries — fast: the counts stage fails before any

@@ -198,9 +198,17 @@ def build_parser() -> argparse.ArgumentParser:
                           "ranks coordinate through shared-filesystem "
                           "leases and per-stripe checkpoint cursors "
                           "instead of lockstep collectives; survivors "
-                          "adopt a dead rank's stripe, and a relaunched "
+                          "adopt a dead rank's stripe, a relaunched "
                           "rank rejoins in place replaying no completed "
-                          "work")
+                          "work, and a new rank (--process-id >= "
+                          "--num-processes) joins live via an admission "
+                          "request")
+    run.add_argument("--autoscale", default=None, metavar="MIN:MAX",
+                     help="With --elastic: the lowest live home rank "
+                          "spawns joiner ranks while the stripe cursors "
+                          "show sustained backlog, up to MAX total "
+                          "workers; joiners drain (fence-and-leave) at "
+                          "idle")
     run.add_argument("--exchange-transport", default="auto",
                      choices=("auto", "kv", "file"),
                      help="With --coordinator: carrier for the lockstep "
@@ -365,10 +373,17 @@ def _cmd_run(args: argparse.Namespace) -> int:
               "multi-host membership layer and require --coordinator",
               file=sys.stderr)
         return 1
-    if args.elastic and (args.run_report or args.auto_geometry):
-        print("--elastic is incompatible with --run-report and "
-              "--auto-geometry (both are full-gang collectives)",
+    if args.elastic and args.auto_geometry:
+        print("--elastic is incompatible with --auto-geometry (geometry "
+              "negotiation is a full-gang collective with no lockstep "
+              "exchange to ride; --run-report IS supported — the merging "
+              "rank folds per-rank report shards)",
               file=sys.stderr)
+        return 1
+    if args.autoscale and not args.elastic:
+        print("--autoscale requires --elastic (the supervisor spawns and "
+              "drains joiner ranks through the elastic membership "
+              "protocol)", file=sys.stderr)
         return 1
     if args.elastic and (
         args.survive_peer_loss or args.exchange_transport == "file"
@@ -445,6 +460,8 @@ def _cmd_run(args: argparse.Namespace) -> int:
                 mh_kwargs["lease_ttl_s"] = args.lease_ttl_s
             if args.elastic:
                 mh_kwargs["elastic"] = True
+            if args.autoscale:
+                mh_kwargs["autoscale"] = args.autoscale
             if args.exchange_transport != "auto":
                 mh_kwargs["exchange_transport"] = args.exchange_transport
             if args.survive_peer_loss:
@@ -604,12 +621,14 @@ def _cmd_run(args: argparse.Namespace) -> int:
     evictions = int(METRICS.get("multihost_evictions_total"))
     rejoins = int(METRICS.get("multihost_rejoins_total"))
     adopted = int(METRICS.get("multihost_adopted_stripes_total"))
-    if (evictions or rejoins or adopted) and not reformations:
+    joins = int(METRICS.get("multihost_rank_joins_total"))
+    if (evictions or rejoins or adopted or joins) and not reformations:
         # Membership churn is an operational signal like a degraded round:
         # the run completed, but not with the gang it started with.
         print(
             f"Elastic membership: {evictions} eviction(s), {rejoins} "
-            f"rejoin(s), {adopted} stripe(s) adopted; final epoch "
+            f"rejoin(s), {joins} join(s), {adopted} stripe(s) adopted; "
+            f"final epoch "
             f"{int(METRICS.get('multihost_membership_epoch'))}.",
             file=sys.stderr,
         )

@@ -1,0 +1,169 @@
+"""Elastic scale-out supervisor (``--autoscale MIN:MAX``).
+
+A small control loop ridden by the **lowest live home rank** of an
+``--elastic`` run (supervision duty fails over exactly like merge duty):
+while the stripe cursors show sustained backlog and the worker count is
+under ``MAX``, it spawns joiner processes — fresh ranks beyond the stripe
+count that enter the gang through the admission protocol
+(:meth:`FileMembershipStore.post_join_request` →
+:func:`~textblaster_tpu.resilience.membership.assign_stripes` rebalance) —
+and at idle the joiners drain themselves: with every stripe consumed they
+post their report shard, withdraw their lease (fence-and-leave), and exit.
+
+The supervisor deliberately holds no protocol state of its own: joiners
+coordinate through the same leases/cursors as everyone else, so a
+supervisor death mid-scale costs nothing (the next lowest home rank's
+ticks take over; already-spawned joiners finish or drain on their own).
+
+Everything observable is injected (``live_ranks``, ``backlog_rows``,
+``spawn_command``), so the policy is unit-testable without processes.
+"""
+
+from __future__ import annotations
+
+import subprocess
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..errors import PipelineError
+from ..utils.metrics import METRICS
+from ..utils.trace import TRACER
+
+__all__ = ["AutoscaleSupervisor", "parse_autoscale"]
+
+
+def parse_autoscale(spec: str, num_stripes: int) -> Tuple[int, int]:
+    """Parse ``"MIN:MAX"`` into validated bounds on the total worker
+    count.  ``MIN`` is the floor the gang never drains below (the home
+    ranks themselves — it may not be below 1 nor above ``MAX``);
+    ``MAX`` caps home ranks + live joiners."""
+    try:
+        lo_s, _, hi_s = spec.partition(":")
+        lo, hi = int(lo_s), int(hi_s)
+    except ValueError:
+        raise PipelineError(
+            f"--autoscale expects MIN:MAX (two integers), got {spec!r}"
+        ) from None
+    if lo < 1 or hi < lo:
+        raise PipelineError(
+            f"--autoscale bounds must satisfy 1 <= MIN <= MAX, got "
+            f"{lo}:{hi}"
+        )
+    if hi <= num_stripes:
+        raise PipelineError(
+            f"--autoscale MAX ({hi}) must exceed the stripe count "
+            f"({num_stripes}) to leave room for at least one joiner"
+        )
+    return lo, hi
+
+
+class AutoscaleSupervisor:
+    """Spawn-under-backlog / drain-at-idle policy for elastic joiners.
+
+    ``tick()`` is called by the owning rank at its loop and committed-chunk
+    boundaries.  It is a no-op unless this rank currently holds
+    supervision duty (lowest live home rank).  Backlog must persist for
+    ``sustain`` consecutive ticks before a spawn — one slow chunk is not a
+    scale-out signal — and each spawn resets the streak, so joiners arrive
+    one at a time and the backlog re-measurement includes their effect.
+    """
+
+    def __init__(
+        self,
+        spec: str,
+        *,
+        num_stripes: int,
+        rank: int,
+        live_ranks: Callable[[], Sequence[int]],
+        backlog_rows: Callable[[], int],
+        spawn_command: Callable[[int], List[str]],
+        say: Callable[[str], None] = lambda _m: None,
+        sustain: int = 2,
+        spawn_fn: Optional[Callable[[List[str]], object]] = None,
+    ) -> None:
+        self.min_ranks, self.max_ranks = parse_autoscale(spec, num_stripes)
+        self.num_stripes = int(num_stripes)
+        self.rank = int(rank)
+        self.live_ranks = live_ranks
+        self.backlog_rows = backlog_rows
+        self.spawn_command = spawn_command
+        self.say = say
+        self.sustain = max(1, int(sustain))
+        self._spawn = spawn_fn or (
+            lambda cmd: subprocess.Popen(cmd)  # noqa: S603 — own argv
+        )
+        self._streak = 0
+        #: joiner rank -> process handle (only this supervisor's spawns;
+        #: a failed-over supervisor sees foreign joiners via live_ranks)
+        self.children: Dict[int, object] = {}
+        self.spawned_total = 0
+
+    # --- policy -------------------------------------------------------------
+
+    def _has_duty(self, live: Sequence[int]) -> bool:
+        home = [r for r in live if r < self.num_stripes]
+        return bool(home) and min(home) == self.rank
+
+    def _next_joiner_id(self, live: Sequence[int]) -> Optional[int]:
+        taken = set(live) | set(self.children)
+        for jid in range(self.num_stripes, self.max_ranks):
+            if jid not in taken:
+                return jid
+        return None
+
+    def reap(self) -> None:
+        """Forget children that exited (drained or died — either way the
+        lease table already reflects it)."""
+        for jid, proc in list(self.children.items()):
+            if proc.poll() is not None:
+                self.say(
+                    f"autoscale: joiner rank {jid} exited "
+                    f"(code {proc.poll()})"
+                )
+                del self.children[jid]
+
+    def tick(self) -> None:
+        live = sorted(set(int(r) for r in self.live_ranks()))
+        if not self._has_duty(live):
+            self._streak = 0
+            return
+        self.reap()
+        backlog = self.backlog_rows()
+        self._streak = self._streak + 1 if backlog > 0 else 0
+        if self._streak < self.sustain:
+            return
+        if len(live) >= self.max_ranks:
+            return
+        jid = self._next_joiner_id(live)
+        if jid is None:
+            return
+        cmd = self.spawn_command(jid)
+        proc = self._spawn(cmd)
+        self.children[jid] = proc
+        self.spawned_total += 1
+        self._streak = 0
+        METRICS.inc("multihost_autoscale_spawned_total")
+        TRACER.instant(
+            "autoscale_spawn",
+            {"joiner": jid, "backlog_rows": backlog,
+             "live": list(live)},
+        )
+        self.say(
+            f"autoscale: spawned joiner rank {jid} "
+            f"(pid {getattr(proc, 'pid', '?')}) — backlog {backlog} "
+            f"row(s), {len(live)}/{self.max_ranks} worker(s)"
+        )
+
+    def drain(self, timeout_s: float = 10.0) -> None:
+        """Wait for this supervisor's spawned joiners to finish their
+        fence-and-leave (they exit on their own once every stripe is
+        consumed); called by the merging rank before it removes the
+        membership directory."""
+        for jid, proc in list(self.children.items()):
+            try:
+                proc.wait(timeout=timeout_s)
+            except Exception:  # noqa: BLE001 — drain is best-effort
+                self.say(
+                    f"autoscale: joiner rank {jid} still running at "
+                    "drain deadline; leaving it to self-fence"
+                )
+        self.reap()

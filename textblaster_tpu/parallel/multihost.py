@@ -753,6 +753,9 @@ class FileLeaseTransport(ExchangeTransport):
         self.reformations += 1
         self.tracker.observe(members)
         new_exchange_epoch = bump_exchange_epoch()
+        self.store.write_roster(
+            members, self.tracker.epoch, new_exchange_epoch
+        )
         METRICS.inc("multihost_gang_reformations_total")
         METRICS.set("multihost_reformation_epoch", float(self.tracker.epoch))
         TRACER.instant(
@@ -777,6 +780,185 @@ class FileLeaseTransport(ExchangeTransport):
             dead_ranks=newly_dead,
             epoch=self.tracker.epoch,
         )
+
+    def maybe_admit(self) -> None:
+        """Phase-boundary admission sweep: observe posted join requests
+        and grow the gang through the reformation machinery.
+
+        Called at every negotiated phase boundary (via
+        :func:`maybe_admit_joiners` from :func:`run_local_shard`) — the one
+        point where no rounds are in flight, so growing the member set
+        cannot strand a launched chunk.  The sweep is collective: a
+        joiner's request file may be visible to some members before others
+        (shared-filesystem propagation), so members first allgather the
+        join ranks each observed and act on the **union** — either every
+        member runs the admission election or none does.  Success bumps
+        the membership and exchange epochs, publishes the grown roster
+        (``roster.json`` — how the joiner learns it is in), clears the
+        handled requests, and raises :exc:`GangReformed` so the driver
+        replays from the phase boundary with the window depth re-negotiated
+        over the grown gang.  A joiner that died mid-admission is fenced by
+        the election and the gang proceeds un-grown (no raise); a *member*
+        death during the sweep folds into the ordinary reformation retry
+        inside :func:`elect_members`."""
+        if not self.survive:
+            return
+        from ..resilience.faults import FAULTS
+        from ..utils.metrics import METRICS
+
+        epoch = _EXCHANGE.epoch
+        local = sorted(
+            r for r in self.store.read_join_requests()
+            if r not in self._members
+        )[:_JOIN_LANES]
+        if len(self._members) == 1:
+            # Solo gang: nobody to agree with, the local view is the union.
+            union = local
+        else:
+            row = local + [-1] * (_JOIN_LANES - len(local))
+            merged = self.allgather(np.asarray(row, dtype=np.int64))
+            union = sorted(
+                {int(x) for x in np.asarray(merged).ravel() if int(x) >= 0}
+                - set(self._members)
+            )
+        if not union:
+            return
+        FAULTS.fire("multihost.join.admit")
+        TRACER.instant(
+            "gang_admission_start",
+            {"exchange_epoch": epoch, "joiners": list(union)},
+        )
+        members, newly_dead = elect_members(
+            self.store,
+            self._members,
+            (),
+            tag=f"join.e{epoch}",
+            deadline_s=_EXCHANGE.deadline_s,
+            joiners=union,
+        )
+        admitted = [r for r in members if r not in self._members]
+        for r in union:
+            # Handled either way: the roster supersedes an admitted
+            # request, and a fenced joiner's request must not re-trigger
+            # the sweep at every subsequent boundary.
+            self.store.clear_join_request(r)
+        if not admitted and not newly_dead:
+            print(
+                f"admit[{self.rank}]: joiner(s) {list(union)} fenced "
+                "mid-admission; gang proceeds un-grown",
+                flush=True,
+            )
+            return
+        self._members = members
+        self.dead_ranks.extend(
+            r for r in newly_dead if r not in self.dead_ranks
+        )
+        if newly_dead:
+            # A member died during the admission sweep: that is a
+            # reformation folded into the same election.
+            self.reformations += 1
+            METRICS.inc("multihost_gang_reformations_total")
+        self.tracker.observe(members)
+        new_exchange_epoch = bump_exchange_epoch()
+        METRICS.set("multihost_reformation_epoch", float(self.tracker.epoch))
+        self.store.write_roster(
+            members, self.tracker.epoch, new_exchange_epoch
+        )
+        TRACER.instant(
+            "gang_admission",
+            {"membership_epoch": self.tracker.epoch,
+             "exchange_epoch": new_exchange_epoch,
+             "members": list(members), "admitted": admitted,
+             "dead": list(newly_dead)},
+        )
+        print(
+            f"admit[{self.rank}]: admitted rank(s) {admitted} at phase "
+            f"boundary (exchange epoch {epoch}); members now "
+            f"{list(members)} at membership epoch {self.tracker.epoch}",
+            flush=True,
+        )
+        raise GangReformed(
+            f"rank(s) {admitted} admitted at exchange epoch {epoch}; "
+            f"members now {list(members)} (membership epoch "
+            f"{self.tracker.epoch})",
+            members=members,
+            dead_ranks=tuple(newly_dead),
+            epoch=self.tracker.epoch,
+        )
+
+
+#: Admission fan-in per phase boundary: the union allgather carries a
+#: fixed-width vector of observed joiner ranks (-1 padding), so at most
+#: this many joiners are admitted per boundary — later requests simply
+#: wait for the next one.
+_JOIN_LANES = 4
+
+
+def maybe_admit_joiners() -> None:
+    """Phase-boundary hook for :func:`run_local_shard`: run the admission
+    sweep when the active exchange transport supports one (the file-lease
+    transport under ``--survive-peer-loss``); a no-op everywhere else, so
+    the KV path's exchange sequence is untouched."""
+    admit = getattr(_EXCHANGE.transport, "maybe_admit", None)
+    if admit is not None:
+        admit()
+
+
+def request_admission(
+    store: FileMembershipStore,
+    *,
+    deadline_s: float = DEFAULT_EXCHANGE_DEADLINE_S,
+    poll_s: float = 0.05,
+) -> dict:
+    """Joiner-side half of the admission protocol (file-lease transport).
+
+    Renews this rank's liveness lease, posts an incarnation-stamped join
+    request next to it, and waits for the running gang to admit it at a
+    phase boundary.  The joiner deliberately does NOT drive the election
+    (:func:`elect_members` fences silent candidates — a joiner running the
+    full driver could fence healthy members on its own deadline); it
+    **echoes**: whenever a gang member's ``join.*`` proposal includes this
+    rank, the joiner posts the identical proposal, making itself a
+    unanimous candidate without ever suspecting anyone.  Admission is
+    learned from ``roster.json`` (published by every admitting member
+    after the epoch bump); the returned roster dict carries ``members``,
+    ``membership_epoch`` and ``exchange_epoch``, so the caller can align
+    its exchange state with the gang before its first collective.
+
+    Raises :exc:`ReformationFailed` when the gang fenced this incarnation
+    (the died-mid-admission verdict, seen from the inside: the gang
+    proceeded un-grown) or when nothing admits it within ``deadline_s``.
+    """
+    store.post()
+    store.post_join_request()
+    t0 = time.monotonic()
+    while True:
+        roster = store.read_roster()
+        if roster is not None and store.rank in {
+            int(r) for r in roster.get("members", ())
+        }:
+            return roster
+        if store.self_fenced():
+            raise ReformationFailed(
+                f"rank {store.rank} (incarnation {store.incarnation}) was "
+                "fenced while awaiting admission: the gang proceeded "
+                "un-grown",
+                rank=store.rank,
+            )
+        for tag, proposed in store.peer_proposals("join.").items():
+            if store.rank in proposed and (
+                store.read_proposal(tag, store.rank) is None
+            ):
+                store.post_proposal(tag, proposed)
+        if time.monotonic() - t0 >= deadline_s:
+            raise ReformationFailed(
+                f"rank {store.rank}'s join request was not admitted within "
+                f"{deadline_s:g}s (no phase boundary reached, or the gang "
+                "is gone)",
+                rank=store.rank,
+            )
+        store.post()  # keep the lease fresh: a stale joiner is invisible
+        time.sleep(poll_s)
 
 
 def resolve_exchange_transport(choice: str, survive_peer_loss: bool) -> str:
@@ -1185,6 +1367,13 @@ def run_local_shard(
             plan: Optional[List[tuple]] = None
             consumed: List[bool] = []
             try:
+                # Admission sweep before any round launches: a posted join
+                # request is observed here, at the phase boundary — the one
+                # point with no rounds in flight — and a successful
+                # admission raises GangReformed into the handler below, so
+                # the re-entry re-negotiates the window depth over the
+                # grown gang exactly as a shrink reformation would.
+                maybe_admit_joiners()
                 if reformed:
                     # Survivor re-entry: re-negotiate the window depth over
                     # the reformed gang (a member with a different local
@@ -1581,6 +1770,7 @@ def run_multihost(
     elastic: bool = False,
     exchange_transport: str = "auto",
     survive_peer_loss: bool = False,
+    autoscale: Optional[str] = None,
 ):
     """Production multi-host entry (``textblast run --coordinator ...``).
 
@@ -1632,8 +1822,19 @@ def run_multihost(
     survivors adopt a dead rank's stripe at the membership-epoch bump, and
     a relaunched rank rejoins mid-run resuming from the committed cursor —
     replaying zero completed chunks, with outcomes byte-identical to a
-    fault-free run.  Incompatible with ``run_report``/``auto_geometry``
-    (both are defined in terms of full-gang collectives).
+    fault-free run.  A brand-new rank (``process_id >= num_processes``)
+    scales the gang OUT mid-run: it posts a join request next to its
+    lease, the members admit it on observation, and
+    :func:`~textblaster_tpu.resilience.membership.assign_stripes` moves a
+    pending stripe to it (the donor fences at its next committed chunk,
+    the joiner adopts the cursor — dead-stripe adoption in reverse).
+    ``run_report`` is supported (the merging rank folds per-rank report
+    shards into the merged v3 report; an aborted run leaves a partial
+    report, like the kv path); ``auto_geometry`` stays incompatible (a
+    full-gang collective with no lockstep exchange to ride).
+    ``autoscale="MIN:MAX"`` arms the supervisor loop on the lowest live
+    home rank: joiners are spawned under sustained backlog and drain
+    (fence-and-leave) at idle.
 
     ``exchange_transport`` / ``survive_peer_loss`` (PR 10): with the
     ``file`` transport (:class:`FileLeaseTransport`; ``auto`` resolves to
@@ -1714,13 +1915,18 @@ def run_multihost(
             "misclassified as a peer death"
         )
 
+    if autoscale is not None and not elastic:
+        raise PipelineError(
+            "--autoscale requires --elastic: the supervisor spawns and "
+            "drains joiner ranks through the elastic membership protocol"
+        )
     if elastic:
-        if run_report is not None or auto_geometry:
+        if auto_geometry:
             raise PipelineError(
-                "--elastic is incompatible with --run-report and "
-                "--auto-geometry: both are full-gang collectives, and "
-                "elastic membership deliberately has no lockstep exchanges "
-                "to carry them"
+                "--elastic is incompatible with --auto-geometry: geometry "
+                "negotiation is a full-gang collective, and elastic "
+                "membership deliberately has no lockstep exchanges to "
+                "carry it"
             )
         return _run_elastic(
             config,
@@ -1737,6 +1943,9 @@ def run_multihost(
             errors_file=errors_file,
             lease_ttl_s=lease_ttl_s,
             force=force,
+            run_report=run_report,
+            provenance=provenance,
+            autoscale=autoscale,
         )
 
     heartbeat = None
@@ -1777,6 +1986,14 @@ def run_multihost(
             deadline_s=exchange_deadline_s,
             lease_store=membership_store,
             transport=file_transport,
+        )
+        # Publish the launch roster (idempotent across ranks — every
+        # writer posts identical content atomically): the membership view
+        # a prospective joiner echoes in its admission election.
+        membership_store.write_roster(
+            file_transport.members(),
+            file_transport.tracker.epoch,
+            current_exchange_epoch(),
         )
         print(
             f"coordinated[{process_id}]: file-lease exchange transport at "
@@ -2417,6 +2634,9 @@ def _run_elastic(
     errors_file: Optional[str],
     lease_ttl_s: float,
     force: bool,
+    run_report: Optional[str] = None,
+    provenance: Optional[dict] = None,
+    autoscale: Optional[str] = None,
 ):
     """Elastic membership execution (``--elastic``) — no lockstep, no gang.
 
@@ -2481,10 +2701,19 @@ def _run_elastic(
     from ..ops.pipeline import CompiledPipeline, process_documents_device
     from ..orchestration import AggregationResult
     from ..resilience.deadletter import DEADLETTER_SCHEMA
-    from ..resilience.faults import arm_from_env
-    from ..resilience.membership import EpochTracker, FileMembershipStore
-    from ..resilience.membership import stripe_owner as owner_of
-    from ..utils.metrics import METRICS
+    from ..resilience.faults import FAULTS, arm_from_env
+    from ..resilience.membership import (
+        EpochTracker,
+        FileMembershipStore,
+        assign_stripes,
+    )
+    from ..utils.metrics import (
+        METRICS,
+        _SPECS,
+        build_run_report,
+        metrics_snapshot,
+        write_run_report,
+    )
     from .mesh import data_mesh
 
     import pyarrow.parquet as pq
@@ -2504,8 +2733,42 @@ def _run_elastic(
     config_hash = _config_fingerprint(config)
     arm_from_env(process_id=process_id)
 
+    # Run-report scope starts here (mirrors the coordinated path): the
+    # metrics delta attributes only this run's work.
+    values_before = metrics_snapshot() if run_report is not None else {}
+    wall_t0 = time.perf_counter()
+
     store = FileMembershipStore(root, process_id, lease_ttl_s)
     store.register()
+    joiner = process_id >= num_processes
+    if joiner:
+        # A joiner exists to help a RUNNING gang.  Without a live home
+        # rank there is nothing to join — most likely the run already
+        # finished and the merger tore the membership directory down, in
+        # which case claiming work here would silently re-execute the
+        # whole job from virgin cursors (and re-merge over the published
+        # outputs).  Bounded grace covers a gang that is still starting.
+        grace = max(2.0, 2.0 * lease_ttl_s)
+        t_grace = time.monotonic() + grace
+        while not any(
+            r < num_processes for r in store.live_ranks()
+        ):
+            if time.monotonic() >= t_grace:
+                store.withdraw()
+                say(
+                    f"no live gang to join (no home-rank lease within "
+                    f"{grace:g}s); exiting without work"
+                )
+                return AggregationResult()
+            store.post()  # a stale joiner lease is invisible to the gang
+            time.sleep(min(0.1, lease_ttl_s / 10.0))
+        # A scale-out joiner (rank beyond the stripe count) is admitted on
+        # the strength of an incarnation-stamped join request posted next
+        # to its lease.  The request is only valid while the lease stays
+        # fresh, so a joiner dying right here (the ``multihost.join.post``
+        # fault site) is never assigned work — the gang proceeds un-grown.
+        store.post_join_request()
+        say(f"posted join request (incarnation {store.incarnation})")
     if TRACER.enabled:
         # File-backend analogue of _align_trace_clocks: the first process
         # to register wrote the run's wall-clock origin; every tracer
@@ -2572,6 +2835,88 @@ def _run_elastic(
         f"{num_processes} stripe(s), lease ttl {lease_ttl_s:g}s)"
     )
 
+    seen_joiners: set = set()
+
+    def assignable(live):
+        # A rank beyond the stripe count is assignable only while its join
+        # request is valid (request present + fresh lease of the same
+        # incarnation, unfenced): a joiner that died before/at its request
+        # post never receives a stripe, and one that dies later drops out
+        # with its lease exactly like a home rank.
+        reqs = store.read_join_requests()
+        picked = sorted(r for r in live if r < num_processes or r in reqs)
+        for r in picked:
+            if r >= num_processes and r not in seen_joiners:
+                seen_joiners.add(r)
+                if r != process_id:
+                    # First observation of a valid join request IS the
+                    # admission on this path (``multihost.join.admit``).
+                    FAULTS.fire("multihost.join.admit")
+                    say(f"admitting joiner rank {r} (epoch {tracker.epoch})")
+        return picked
+
+    def owners_now(live):
+        pending = [s for s in range(num_processes) if not stripe_done(s)]
+        return assign_stripes(pending, assignable(live), num_processes)
+
+    supervisor = None
+    if autoscale is not None:
+        from .autoscale import AutoscaleSupervisor
+
+        cfg_path = (provenance or {}).get("pipeline_config")
+        if cfg_path is None:
+            raise PipelineError(
+                "--autoscale needs the pipeline-config path in the run "
+                "provenance to respawn joiners (both CLI entries provide "
+                "it)"
+            )
+
+        def backlog_rows() -> int:
+            total = 0
+            for s in range(num_processes):
+                _sk, tk = window(s)
+                if tk <= 0:
+                    continue
+                st = CheckpointState.load(store.stripe_dir(s))
+                total += tk - (st.rows_consumed if st is not None else 0)
+            return max(0, total)
+
+        def spawn_command(jid: int):
+            import sys as _sys
+
+            cmd = [
+                _sys.executable, "-m",
+                "textblaster_tpu.parallel.multihost",
+                "--coordinator", "autoscale:0",
+                "--num-processes", str(num_processes),
+                "--process-id", str(jid),
+                "--pipeline-config", str(cfg_path),
+                "-i", input_file,
+                "-o", output_file,
+                "-e", excluded_file,
+                "--elastic",
+                "--lease-ttl-s", str(lease_ttl_s),
+                "--read-batch-size", str(read_batch_size),
+                "--buckets", ",".join(str(b) for b in sorted(buckets)),
+                "--text-column", text_column,
+                "--id-column", id_column,
+            ]
+            if device_batch is not None:
+                cmd += ["--device-batch", str(device_batch)]
+            if errors_file is not None:
+                cmd += ["--errors-file", errors_file]
+            return cmd
+
+        supervisor = AutoscaleSupervisor(
+            autoscale,
+            num_stripes=num_processes,
+            rank=process_id,
+            live_ranks=store.live_ranks,
+            backlog_rows=backlog_rows,
+            spawn_command=spawn_command,
+            say=say,
+        )
+
     def self_fence() -> None:
         if heartbeat.failed or not store.my_lease_fresh():
             raise PipelineError(
@@ -2581,18 +2926,38 @@ def _run_elastic(
                 "stripe's adopter, so this process stops instead"
             )
 
+    # A joiner may only START working while a home rank is live (the
+    # pre-compile grace check above, re-verified here because the gang can
+    # finish and tear down during this process's pipeline compile).  Once
+    # latched it is an ordinary member: if the home ranks die later it
+    # keeps its adopted work and can even inherit merge duty.
+    gang_seen = not joiner
     try:
         while True:
             self_fence()
             live = store.live_ranks()
+            if not gang_seen:
+                if any(r < num_processes for r in live):
+                    gang_seen = True
+                else:
+                    say(
+                        "gang disappeared before this joiner was "
+                        "assigned work; exiting without work"
+                    )
+                    store.clear_join_request(process_id)
+                    store.withdraw()
+                    return local
             for msg in tracker.observe(live):
                 say(msg)
+            if supervisor is not None:
+                supervisor.tick()
             progressed = False
+            owners = owners_now(live)
             for s in range(num_processes):
                 _skip, take = window(s)
                 if take <= 0 or stripe_done(s):
                     continue
-                if owner_of(s, live) != process_id:
+                if owners.get(s) != process_id:
                     continue
                 st_dir = store.stripe_dir(s)
                 cur = CheckpointState.load(st_dir)
@@ -2651,7 +3016,7 @@ def _run_elastic(
 
                 def fence(s=s, st_dir=st_dir) -> None:
                     self_fence()
-                    if owner_of(s, store.live_ranks()) != process_id:
+                    if owners_now(store.live_ranks()).get(s) != process_id:
                         raise StripeLost(
                             f"stripe {s} ownership moved (membership "
                             "changed)"
@@ -2669,6 +3034,11 @@ def _run_elastic(
                         f"{state.rows_consumed}/{take} "
                         f"(epoch {tracker.epoch})"
                     )
+                    if supervisor is not None:
+                        # The supervising rank spends most of the run
+                        # inside its own stripe; committed chunk
+                        # boundaries are its scaling cadence.
+                        supervisor.tick()
 
                 done = run_stripe_checkpointed(
                     input_file,
@@ -2703,8 +3073,73 @@ def _run_elastic(
                 break
             if not progressed:
                 time.sleep(interval)
+    except BaseException as exc:
+        # Aborted elastic run: still leave a machine-readable partial
+        # report (this rank's contribution, flagged) — the same contract
+        # the kv path keeps on a PeerFailure abort.
+        if run_report is not None and not isinstance(exc, GeneratorExit):
+            now = metrics_snapshot()
+            delta = {
+                k: round(now.get(k, 0.0) - values_before.get(k, 0.0), 6)
+                for k in set(now) | set(values_before)
+                if now.get(k, 0.0) != values_before.get(k, 0.0)
+            }
+            partial = build_run_report(
+                values=delta,
+                wall_time_s=round(time.perf_counter() - wall_t0, 3),
+                counts={
+                    "received": local.received,
+                    "success": local.success,
+                    "filtered": local.filtered,
+                    "errors": local.errors,
+                    "read_errors": local.read_errors,
+                },
+                provenance=provenance,
+            )
+            partial["aborted"] = True
+            partial["abort_reason"] = f"{type(exc).__name__}: {exc}"
+            try:
+                write_run_report(run_report, partial)
+            except OSError:
+                pass  # the abort itself stays the headline
+        raise
     finally:
         heartbeat.stop()
+
+    report_dir = os.path.join(root, "report")
+    if run_report is not None:
+        # Post this rank's report shard before withdrawing: the merging
+        # rank folds whatever shards the (possibly churned) membership
+        # left behind — counts stay exact either way, they come from the
+        # stripe cursors.
+        now = metrics_snapshot()
+        delta = {
+            k: round(now.get(k, 0.0) - values_before.get(k, 0.0), 6)
+            for k in set(now) | set(values_before)
+            if now.get(k, 0.0) != values_before.get(k, 0.0)
+        }
+        os.makedirs(report_dir, exist_ok=True)
+        path = os.path.join(report_dir, f"rank{process_id}.json")
+        tmp = f"{path}.tmp.{store.incarnation}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(
+                {
+                    "process": process_id,
+                    "wall_time_s": round(
+                        time.perf_counter() - wall_t0, 3
+                    ),
+                    "counts": {
+                        "received": local.received,
+                        "success": local.success,
+                        "filtered": local.filtered,
+                        "errors": local.errors,
+                        "read_errors": local.read_errors,
+                    },
+                    "metrics": delta,
+                },
+                f,
+            )
+        os.replace(tmp, path)
 
     live = store.live_ranks()
     merger = min(live) if live else process_id
@@ -2712,6 +3147,43 @@ def _run_elastic(
         store.withdraw()
         say(f"all stripes consumed; rank {merger} merges; local done")
         return local
+
+    host_reports: List[dict] = []
+    if run_report is not None:
+        # Bounded wait for the other live ranks' report shards: each posts
+        # before withdrawing, so every rank either reports or lets its
+        # lease lapse.
+        deadline = time.monotonic() + max(2.0, 2.0 * lease_ttl_s)
+        while time.monotonic() < deadline:
+            try:
+                posted = {
+                    int(n[len("rank"):-len(".json")])
+                    for n in os.listdir(report_dir)
+                    if n.startswith("rank") and n.endswith(".json")
+                }
+            except (FileNotFoundError, ValueError):
+                posted = set()
+            if not [
+                r for r in store.live_ranks()
+                if r != process_id and r not in posted
+            ]:
+                break
+            time.sleep(0.05)
+        try:
+            names = sorted(os.listdir(report_dir))
+        except FileNotFoundError:
+            names = []
+        for n in names:
+            if not (n.startswith("rank") and n.endswith(".json")):
+                continue
+            try:
+                with open(
+                    os.path.join(report_dir, n), encoding="utf-8"
+                ) as f:
+                    host_reports.append(json.load(f))
+            except (OSError, ValueError):
+                continue
+        host_reports.sort(key=lambda h: int(h.get("process", 0)))
 
     # Merge duty: lowest live rank (fails over like stripe ownership —
     # if the merger dies here, any relaunched/surviving rank re-enters,
@@ -2742,6 +3214,39 @@ def _run_elastic(
         merged.filtered += cur.filtered
         merged.errors += cur.errors
         merged.read_errors += cur.read_errors
+    if run_report is not None:
+        summed: dict = {}
+        for h in host_reports:
+            for k, v in h.get("metrics", {}).items():
+                # Same merge rule as the coordinated path: counters sum
+                # across ranks, gauges (gang-agreed values like the
+                # membership epoch) merge by max.
+                if _SPECS.get(k, ("counter",))[0] == "gauge":
+                    summed[k] = max(summed.get(k, v), v)
+                else:
+                    summed[k] = summed.get(k, 0.0) + v
+        report = build_run_report(
+            values=summed,
+            wall_time_s=max(
+                [h.get("wall_time_s", 0.0) for h in host_reports]
+                or [round(time.perf_counter() - wall_t0, 3)]
+            ),
+            counts={
+                "received": merged.received,
+                "success": merged.success,
+                "filtered": merged.filtered,
+                "errors": merged.errors,
+                "read_errors": merged.read_errors,
+            },
+            provenance=provenance,
+            hosts=host_reports,
+        )
+        write_run_report(run_report, report)
+    if supervisor is not None:
+        # Joiners leave on their own once every stripe is consumed
+        # (fence-and-leave: report shard, lease withdrawal, clean exit);
+        # reap them before the membership dir disappears under them.
+        supervisor.drain(timeout_s=max(2.0, 4.0 * lease_ttl_s))
     store.withdraw()
     shutil.rmtree(root, ignore_errors=True)
     say(
@@ -2790,8 +3295,17 @@ def _main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument(
         "--elastic", action="store_true",
         help="elastic membership: shared-filesystem leases + per-stripe "
-        "checkpoint cursors; survivors adopt dead ranks' stripes and "
-        "relaunched ranks rejoin in place",
+        "checkpoint cursors; survivors adopt dead ranks' stripes, "
+        "relaunched ranks rejoin in place, and new ranks "
+        "(--process-id >= --num-processes) join live via an admission "
+        "request",
+    )
+    ap.add_argument(
+        "--autoscale", default=None, metavar="MIN:MAX",
+        help="elastic-only supervisor: the lowest live home rank spawns "
+        "joiner ranks (ids >= --num-processes) while backlog persists, "
+        "up to MAX total workers; joiners drain (fence-and-leave) at "
+        "idle",
     )
     ap.add_argument(
         "--exchange-transport", choices=("auto", "kv", "file"),
@@ -2908,6 +3422,7 @@ def _main(argv: Optional[Sequence[str]] = None) -> int:
             elastic=args.elastic,
             exchange_transport=args.exchange_transport,
             survive_peer_loss=args.survive_peer_loss,
+            autoscale=args.autoscale,
             provenance={
                 "entry": "textblaster_tpu.parallel.multihost",
                 "pipeline_config": args.pipeline_config,

@@ -27,7 +27,15 @@ Sites planted in this build:
   deadline and, under ``--survive-peer-loss``, reform around it);
 * ``"multihost.reform"``  — per reformation election attempt
   (:func:`textblaster_tpu.resilience.membership.elect_members`), so the
-  reformation protocol itself is chaos-testable.
+  reformation protocol itself is chaos-testable;
+* ``"multihost.join.post"`` — per join-request post
+  (:meth:`FileMembershipStore.post_join_request` — an armed fault kills a
+  joiner before its request lands, so the gang never sees it and proceeds
+  un-grown);
+* ``"multihost.join.admit"`` — per admission observation on the gang side
+  (a member noticing a valid join request, on both the lockstep
+  phase-boundary path and the ``--elastic`` loop — an armed fault makes
+  one member die mid-admission, folding into the reformation retry).
 
 The injector is **inert by default**: with nothing armed, :meth:`fire` is a
 single attribute load + falsy check and keeps no per-call state, so

@@ -67,6 +67,7 @@ __all__ = [
     "LeaseHeartbeat",
     "EpochTracker",
     "stripe_owner",
+    "assign_stripes",
     "elect_members",
     "LEASE_PREFIX",
 ]
@@ -130,7 +131,12 @@ class KVLeaseStore:
     def post(self) -> None:
         """Renew this rank's lease (the heartbeat body)."""
         FAULTS.fire("multihost.lease")
+        t0 = time.perf_counter()
         _kv_set(self.client, f"{LEASE_PREFIX}{self.rank}", f"{time.time():.3f}")
+        METRICS.observe_hdr(
+            "multihost_lease_renew_latency_seconds",
+            int((time.perf_counter() - t0) * 1e6),
+        )
         METRICS.inc("multihost_lease_renewals_total")
 
     def read_all(self) -> Dict[int, float]:
@@ -217,6 +223,7 @@ class FileMembershipStore:
     def post(self) -> None:
         """Renew this rank's lease file (the heartbeat body)."""
         FAULTS.fire("multihost.lease")
+        t0 = time.perf_counter()
         path = self._lease_path(self.rank)
         tmp = f"{path}.tmp.{self.incarnation}"
         with open(tmp, "w", encoding="utf-8") as f:
@@ -230,6 +237,10 @@ class FileMembershipStore:
                 f,
             )
         os.replace(tmp, path)
+        METRICS.observe_hdr(
+            "multihost_lease_renew_latency_seconds",
+            int((time.perf_counter() - t0) * 1e6),
+        )
         METRICS.inc("multihost_lease_renewals_total")
 
     def withdraw(self) -> None:
@@ -276,6 +287,14 @@ class FileMembershipStore:
         of this rank took over)."""
         now = time.time() if now is None else now
         d = self.read_leases().get(self.rank)
+        if d is not None and d.get("incarnation") == self.incarnation:
+            # Heartbeat-starvation gauge: how close the last renewal sits
+            # to the TTL at this check (>= 1.0 means the lease went stale
+            # — e.g. a GIL-holding compile starved the heartbeat thread).
+            METRICS.set(
+                "multihost_lease_age_ratio",
+                max(0.0, now - float(d.get("time", 0.0))) / self.ttl_s,
+            )
         return (
             d is not None
             and d.get("incarnation") == self.incarnation
@@ -464,6 +483,161 @@ class FileMembershipStore:
         except (OSError, ValueError):
             return None
 
+    def peer_proposals(self, prefix: str) -> Dict[str, List[int]]:
+        """``{attempt_tag: members}`` for every posted proposal whose tag
+        starts with ``prefix``, excluding this rank's own posts — the
+        joiner's passive view of an in-flight admission election, echoed
+        back so every candidate proposes.  One peer proposal per tag
+        (lowest-rank poster wins the read, deterministically)."""
+        out: Dict[str, List[int]] = {}
+        base = os.path.join(self.root, "reform")
+        try:
+            tags = os.listdir(base)
+        except FileNotFoundError:
+            return out
+        for tag in tags:
+            if not tag.startswith(prefix):
+                continue
+            try:
+                names = sorted(os.listdir(os.path.join(base, tag)))
+            except OSError:
+                continue
+            for name in names:
+                if not (name.startswith("rank") and name.endswith(".json")):
+                    continue
+                if name == f"rank{self.rank}.json":
+                    continue
+                try:
+                    with open(
+                        os.path.join(base, tag, name), encoding="utf-8"
+                    ) as f:
+                        p = json.load(f)
+                    out[tag] = [int(r) for r in p["members"]]
+                except (OSError, ValueError, KeyError, TypeError):
+                    continue
+                break
+        return out
+
+    # --- join requests (live scale-out admission) ----------------------------
+    #
+    # ``join/rank{r}.json`` is an incarnation-stamped request to enter the
+    # gang, posted next to the liveness leases.  A request is only *valid*
+    # while its poster also holds a fresh lease of the same incarnation and
+    # is unfenced — so a joiner that dies mid-admission (or gets fenced for
+    # never proposing) simply stops being a candidate; no cleanup protocol
+    # is needed for the gang to proceed un-grown.
+
+    def _join_dir(self) -> str:
+        return os.path.join(self.root, "join")
+
+    def post_join_request(self) -> None:
+        """Request admission into the running gang (fires the
+        ``multihost.join.post`` fault site)."""
+        FAULTS.fire("multihost.join.post")
+        d = self._join_dir()
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, f"rank{self.rank}.json")
+        tmp = f"{path}.tmp.{self.incarnation}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(
+                {
+                    "rank": self.rank,
+                    "incarnation": self.incarnation,
+                    "time": time.time(),
+                    "pid": os.getpid(),
+                },
+                f,
+            )
+        os.replace(tmp, path)
+        METRICS.inc("multihost_join_requests_total")
+        TRACER.instant(
+            "join_request",
+            {"rank": self.rank, "incarnation": self.incarnation},
+        )
+
+    def read_join_requests(
+        self, now: Optional[float] = None
+    ) -> Dict[int, dict]:
+        """Valid join requests: ``{rank: request}`` where the poster is
+        unfenced and its lease (same incarnation) is fresh."""
+        now = time.time() if now is None else now
+        out: Dict[int, dict] = {}
+        try:
+            names = os.listdir(self._join_dir())
+        except FileNotFoundError:
+            return out
+        leases = self.read_leases()
+        for name in names:
+            if not (name.startswith("rank") and name.endswith(".json")):
+                continue
+            try:
+                with open(
+                    os.path.join(self._join_dir(), name), encoding="utf-8"
+                ) as f:
+                    d = json.load(f)
+                rank, inc = int(d["rank"]), str(d["incarnation"])
+            except (OSError, ValueError, KeyError, TypeError):
+                continue
+            if self.is_fenced(rank, inc):
+                continue
+            lease = leases.get(rank)
+            if (
+                lease is None
+                or lease.get("incarnation") != inc
+                or now - float(lease.get("time", 0.0)) > self.ttl_s
+            ):
+                continue
+            out[rank] = d
+        return out
+
+    def clear_join_request(self, rank: int) -> None:
+        """Drop ``rank``'s join request (admission completed, or the
+        joiner withdrew/was fenced)."""
+        try:
+            os.remove(
+                os.path.join(self._join_dir(), f"rank{int(rank)}.json")
+            )
+        except OSError:
+            pass
+
+    # --- roster (gang-published membership view) -----------------------------
+    #
+    # ``roster.json`` is the gang's authoritative published membership:
+    # written by every member after each admission/reformation election.
+    # A joiner polls it to learn (a) the member set it must echo in the
+    # admission election and (b) that its admission landed, plus the
+    # exchange epoch it must sync to before its first collective.
+
+    def write_roster(
+        self,
+        members: Sequence[int],
+        membership_epoch: int,
+        exchange_epoch: int,
+    ) -> None:
+        path = os.path.join(self.root, "roster.json")
+        tmp = f"{path}.tmp.{self.incarnation}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(
+                {
+                    "members": sorted(int(r) for r in members),
+                    "membership_epoch": int(membership_epoch),
+                    "exchange_epoch": int(exchange_epoch),
+                    "by": self.rank,
+                    "time": time.time(),
+                },
+                f,
+            )
+        os.replace(tmp, path)
+
+    def read_roster(self) -> Optional[dict]:
+        try:
+            with open(
+                os.path.join(self.root, "roster.json"), encoding="utf-8"
+            ) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
 
 class LeaseHeartbeat:
     """Daemon thread renewing a lease store every ``interval_s``.
@@ -520,11 +694,81 @@ def stripe_owner(stripe: int, live: Sequence[int]) -> Optional[int]:
     stripe ``s`` belongs to rank ``s`` while rank ``s`` is live; an
     orphaned stripe is adopted by the **lowest live rank** (the same
     successor rule that fails merge duty over).  ``None`` when nobody is
-    live to own it."""
+    live to own it.  :func:`assign_stripes` is the scale-out-aware
+    generalization (orphan spreading + joiner rebalancing); this
+    single-stripe rule remains for the fixed-gang cases."""
     live = sorted(int(r) for r in live)
     if not live:
         return None
     return int(stripe) if int(stripe) in live else live[0]
+
+
+def assign_stripes(
+    pending: Sequence[int],
+    live: Sequence[int],
+    num_stripes: int,
+) -> Dict[int, Optional[int]]:
+    """Deterministic stripe→owner assignment every rank computes
+    identically from the shared ``(pending, live)`` view — the scale-out
+    generalization of :func:`stripe_owner`:
+
+    1. **Home affinity** — pending stripe ``s`` belongs to rank ``s``
+       while rank ``s`` is live.
+    2. **Orphans** — a pending stripe whose home rank is dead goes to the
+       least-loaded live rank (ties → lowest rank), which degenerates to
+       :func:`stripe_owner`'s lowest-live-rank rule whenever a single
+       survivor remains.
+    3. **Joiner rebalance** — an idle *joiner* (rank ``>= num_stripes``,
+       so it has no home stripe ever) steals one pending stripe from the
+       most-loaded donor (ties → highest rank; the donor's highest stripe
+       moves).  The donor discovers the move at its next committed chunk
+       boundary (its fence raises ``StripeLost``) and the joiner adopts
+       the remaining cursor — dead-stripe adoption run in reverse, so no
+       chunk is processed twice and merge order is unchanged.
+
+    Pure function of its inputs: the assignment is stable until
+    ``pending`` or ``live`` changes, so transient disagreement between
+    ranks reading the lease table at different instants converges the
+    same way stripe adoption always has (fence + atomic cursor rename).
+    ``None`` owners mean nobody is live."""
+    live_s = sorted({int(r) for r in live})
+    pending_s = sorted({int(s) for s in pending})
+    if not live_s:
+        return {s: None for s in pending_s}
+    assign: Dict[int, Optional[int]] = {}
+    load = {r: 0 for r in live_s}
+    orphans = []
+    for s in pending_s:
+        if s in load:
+            assign[s] = s
+            load[s] += 1
+        else:
+            orphans.append(s)
+    for s in orphans:
+        r = min(live_s, key=lambda q: (load[q], q))
+        assign[s] = r
+        load[r] += 1
+    stolen: set = set()
+    for thief in [
+        r for r in live_s if load[r] == 0 and r >= int(num_stripes)
+    ]:
+        donors = [
+            r
+            for r in live_s
+            if r != thief
+            and any(o == r and s not in stolen for s, o in assign.items())
+        ]
+        if not donors:
+            break
+        donor = max(donors, key=lambda q: (load[q], q))
+        take = max(
+            s for s, o in assign.items() if o == donor and s not in stolen
+        )
+        assign[take] = thief
+        stolen.add(take)
+        load[donor] -= 1
+        load[thief] += 1
+    return assign
 
 
 def elect_members(
@@ -535,8 +779,9 @@ def elect_members(
     deadline_s: float,
     max_attempts: int = 8,
     poll_s: float = 0.02,
+    joiners: Sequence[int] = (),
 ) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
-    """Deterministic survivor election for gang reformation.
+    """Deterministic survivor election for gang reformation and admission.
 
     Every survivor of a failed lockstep exchange is blocked at the *same*
     ``(epoch, seq)`` (exchanges are blocking and lockstep), so ``tag`` —
@@ -547,14 +792,25 @@ def elect_members(
        regardless of whether the suspect was dead or merely wedged — a
        fenced zombie discovers the fence at its next exchange post and
        terminates typed rather than splitting the brain.
-    2. Compute candidates = ``members`` minus all fenced ranks (the fence
-       table is shared and only ever grows, so survivors converge on it).
+    2. Compute candidates = ``members`` plus unfenced ``joiners`` minus
+       all fenced ranks (the fence table is shared and only ever grows,
+       so survivors converge on it).
     3. Post a proposal naming the candidate set; wait (deadline-bounded)
        for a proposal from every candidate.
     4. All proposals identical → elected.  A missing proposer joins the
        suspects for the next attempt; a disagreeing proposal's exclusions
-       are adopted (union of everyone's suspicions) and the attempt
-       repeats against the merged fence table.
+       of *base members* are adopted (union of everyone's suspicions) and
+       any joiners it admits that this process hasn't seen are merged in —
+       a joiner is never suspected merely for being unknown to a peer
+       (only the shared fence table excludes a dead joiner), so a join
+       request racing a fence converges to the same member set on every
+       survivor regardless of observation order.
+
+    ``joiners`` generalizes reformation into **admission**: ranks outside
+    ``members`` with a posted join request become candidates too.  An
+    admitted joiner appears in ``new_members``; a joiner that dies
+    mid-election is fenced like any silent candidate but never reported
+    in ``newly_dead`` (it was not a member yet).
 
     Returns ``(new_members, newly_dead)``.  Raises
     :class:`~textblaster_tpu.errors.ReformationFailed` when this process
@@ -567,6 +823,7 @@ def elect_members(
     """
     me = store.rank
     members = sorted({int(r) for r in members})
+    joiners = {int(r) for r in joiners} - set(members)
     suspects = {int(r) for r in suspects} - {me}
     for attempt in range(max_attempts):
         FAULTS.fire("multihost.reform")
@@ -580,7 +837,9 @@ def elect_members(
                 rank=me,
             )
         fenced = set(store.fenced_ranks()) - {me}
-        candidates = [r for r in members if r not in fenced]
+        candidates = sorted(
+            r for r in set(members) | joiners if r not in fenced
+        )
         if not candidates or me not in candidates:
             raise ReformationFailed(
                 f"rank {me} computed an empty/self-excluding candidate set "
@@ -609,11 +868,14 @@ def elect_members(
             newly_dead = tuple(r for r in members if r not in candidates)
             return tuple(candidates), newly_dead
         # A candidate that never proposed is itself suspect now; a
-        # disagreeing candidate saw fences this process hasn't — adopt its
-        # exclusions and retry against the merged fence table.
+        # disagreeing candidate saw fences (or join requests) this process
+        # hasn't — adopt its exclusions of base members, merge in its
+        # joiners, and retry against the merged fence table.
         suspects |= set(missing)
         for p in proposals.values():
-            suspects |= set(members) - {int(r) for r in p.get("members", ())}
+            pm = {int(r) for r in p.get("members", ())}
+            suspects |= set(members) - pm
+            joiners |= pm - set(members)
         suspects -= {me}
     raise ReformationFailed(
         f"election did not converge after {max_attempts} attempts "
@@ -629,24 +891,35 @@ class EpochTracker:
     (empty when nothing changed) and maintains the counters/instants:
     ``multihost_membership_epoch`` (gauge), ``multihost_evictions_total``
     and ``multihost_rejoins_total``, plus ``membership_evict`` /
-    ``membership_rejoin`` trace instants carrying the epoch."""
+    ``membership_rejoin`` trace instants carrying the epoch.
+
+    A rank appearing that was *never* in any prior live set is a live
+    scale-out **join** (not a restart-in-place rejoin): it gets a
+    ``membership_join`` instant, and exactly one member — the lowest rank
+    of the previous live set — counts ``multihost_rank_joins_total``, so
+    the sum-merged run report reads joins, not member-observations.  (The
+    joiner's own first ``observe`` baselines with itself included, so it
+    never counts its own admission.)"""
 
     def __init__(self, rank: int) -> None:
         self.rank = int(rank)
         self.epoch = 1
         self.live: Optional[Tuple[int, ...]] = None
+        self.ever: set = set()
         METRICS.set("multihost_membership_epoch", self.epoch)
 
     def observe(self, live: Sequence[int]) -> List[str]:
         now = tuple(sorted(int(r) for r in live))
         if self.live is None:
             self.live = now
+            self.ever = set(now)
             return []
         if now == self.live:
             return []
         events: List[str] = []
         evicted = set(self.live) - set(now)
-        joined = set(now) - set(self.live)
+        appeared = set(now) - set(self.live)
+        prev_min = min(self.live) if self.live else None
         self.epoch += 1
         METRICS.set("multihost_membership_epoch", self.epoch)
         for r in sorted(evicted):
@@ -655,11 +928,22 @@ class EpochTracker:
                 "membership_evict", {"rank": r, "epoch": self.epoch}
             )
             events.append(f"evicted rank {r} (lease expired); epoch {self.epoch}")
-        for r in sorted(joined):
-            METRICS.inc("multihost_rejoins_total")
-            TRACER.instant(
-                "membership_rejoin", {"rank": r, "epoch": self.epoch}
-            )
-            events.append(f"rank {r} rejoined; epoch {self.epoch}")
+        for r in sorted(appeared):
+            if r in self.ever:
+                METRICS.inc("multihost_rejoins_total")
+                TRACER.instant(
+                    "membership_rejoin", {"rank": r, "epoch": self.epoch}
+                )
+                events.append(f"rank {r} rejoined; epoch {self.epoch}")
+            else:
+                if prev_min == self.rank:
+                    METRICS.inc("multihost_rank_joins_total")
+                TRACER.instant(
+                    "membership_join", {"rank": r, "epoch": self.epoch}
+                )
+                events.append(
+                    f"rank {r} joined the gang; epoch {self.epoch}"
+                )
+        self.ever |= set(now)
         self.live = now
         return events

@@ -155,6 +155,11 @@ HDR_SPECS: Dict[str, str] = {
         "Per-collective host_allgather post latency (log-linear buckets, "
         "relative error <= 1/32)"
     ),
+    "multihost_lease_renew_latency_seconds": (
+        "Per-renewal liveness-lease post latency, KV and file backends "
+        "(log-linear buckets, relative error <= 1/32) — a fattening tail "
+        "means heartbeat starvation is approaching the TTL"
+    ),
 }
 
 # Metric name -> (type, help) — prometheus_metrics.rs:16-143.
@@ -328,6 +333,28 @@ _SPECS: Dict[str, Tuple[str, str]] = {
     "multihost_lease_renewals_total": (
         "counter",
         "Liveness lease renewals posted by this process's heartbeat",
+    ),
+    "multihost_lease_age_ratio": (
+        "gauge",
+        "Own-lease age over TTL at the last self-fence/liveness check "
+        "(>= 1.0 means the lease went stale — heartbeat starvation, e.g. "
+        "a GIL-holding XLA compile)",
+    ),
+    "multihost_join_requests_total": (
+        "counter",
+        "Join requests this process posted next to the liveness leases "
+        "(live scale-out admission)",
+    ),
+    "multihost_rank_joins_total": (
+        "counter",
+        "New ranks admitted into the running gang (live scale-out joins; "
+        "counted once per join by the lowest previously-live rank, so the "
+        "sum-merged run report reads joins, not member-observations)",
+    ),
+    "multihost_autoscale_spawned_total": (
+        "counter",
+        "Joiner processes spawned by the --autoscale supervisor under "
+        "sustained backlog",
     ),
     # Overlapped multi-host lockstep (parallel/multihost.py): the in-flight
     # round window is negotiated once at run start (min over every host's
@@ -789,6 +816,7 @@ def latency_report(
     stages: Dict[str, object] = {}
     families = [(s, f"doc_latency_{s}_seconds") for s in DOC_LATENCY_STAGES]
     families.append(("exchange_post", "exchange_post_latency_seconds"))
+    families.append(("lease_renew", "multihost_lease_renew_latency_seconds"))
     for stage, fam in families:
         buckets, sum_us, count = _hdr_delta(vals, base, fam)
         if count <= 0:
