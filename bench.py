@@ -1141,9 +1141,11 @@ def main() -> int:
     # change, not a semantic one.
     mh_overlap_report = None
     mh_reform_report = None
+    mh_speculate_report = None
     _mh_overlap_on = os.environ.get("BENCH_MULTIHOST_OVERLAP", "1") != "0"
     _mh_reform_on = os.environ.get("BENCH_REFORM", "0") == "1"
-    if _mh_overlap_on or _mh_reform_on:
+    _mh_spec_on = os.environ.get("BENCH_SPECULATE", "1") != "0"
+    if _mh_overlap_on or _mh_reform_on or _mh_spec_on:
         import socket
         import tempfile
 
@@ -1165,7 +1167,7 @@ pipeline:
     stop_words: [ "og", "the", "er", "i" ]
 """
 
-        def _mh_pass(root, inp, tag, extra_args):
+        def _mh_pass(root, inp, tag, extra_args, extra_env=None):
             out = os.path.join(root, f"{tag}-kept.parquet")
             exc = os.path.join(root, f"{tag}-exc.parquet")
             rep = os.path.join(root, f"{tag}-report.json")
@@ -1179,6 +1181,7 @@ pipeline:
                 "HOME": os.environ.get("HOME", "/root"),
                 "TEXTBLAST_AOT_CACHE_DIR": os.path.join(root, "aot"),
             }
+            env.update(extra_env or {})
             procs = [
                 subprocess.Popen(
                     [
@@ -1296,6 +1299,25 @@ pipeline:
                     "window_replayed_rounds": int(
                         res.get("multihost_window_replayed_rounds_total", 0)
                     ),
+                    # Speculative cross-phase dispatch counters from the
+                    # overlapped arm (speculation rides the window by
+                    # default): rounds launched past a phase barrier, rounds
+                    # voided by a joint fault, and barriers whose per-round
+                    # exchanges collapsed into the combined post.
+                    "speculation": {
+                        "speculated_rounds": int(
+                            res.get("multihost_speculated_rounds_total", 0)
+                        ),
+                        "voided_rounds": int(
+                            res.get("multihost_voided_rounds_total", 0)
+                        ),
+                        "barrier_elisions": int(
+                            res.get("multihost_barrier_elisions_total", 0)
+                        ),
+                        "depth": int(
+                            res.get("multihost_speculate_depth", 0)
+                        ),
+                    },
                     "lockstep_s": {
                         "overlapped": round(ov_s, 3),
                         "serial": round(se_s, 3),
@@ -1333,6 +1355,132 @@ pipeline:
         except Exception as e:  # never bill a 2-proc spawn problem to the bench
             mh_overlap_report = {"error": f"{type(e).__name__}: {e}"[:500]}
             _log(f"multihost overlap A/B skipped: {e}")
+
+    # --- Speculative cross-phase dispatch A/B (BENCH_SPECULATE=0 skips).
+    # Two fault-free coordinated 2-process runs on the file-lease transport
+    # (--pipeline-depth 3 both ways), speculation on (the default) vs
+    # TEXTBLAST_SPECULATE=off.  On the file transport every exchange post
+    # is a slot file + peer poll, so the barrier elision (verdicts + join
+    # sweep + schedule negotiation in ONE vector post) shows up directly as
+    # strictly fewer posts per interior phase barrier, and launching the
+    # next phase's confirmed rounds before the tail verdicts convene shows
+    # up as reduced window stall.  Outputs must be ordered-identical —
+    # speculation is a scheduling change, never a semantic one.
+    if _mh_spec_on:
+        try:
+            with tempfile.TemporaryDirectory(prefix="bench-spec-") as root:
+                sp_docs, inp = _mh_input(root)
+                sp_args = [
+                    "--exchange-transport", "file", "--pipeline-depth", "3",
+                    # The bench box is still settling from the main timed
+                    # passes; the default 10s lease TTL is tight enough
+                    # that a load-starved heartbeat gets a rank evicted
+                    # mid-run, so give the liveness layer headroom — this
+                    # arm measures barrier posts, not lease churn.
+                    "--lease-ttl-s", "30",
+                ]
+                # One untimed warm run populates the shared AOT cache for
+                # both arms: the speculation knob is scheduling-only and
+                # deliberately excluded from compile-cache keys, so the two
+                # arms run the same executables.
+                _mh_pass(root, inp, "warm", sp_args,
+                         {"TEXTBLAST_SPECULATE": "off"})
+                off_rep, off_out, off_exc = _mh_pass(
+                    root, inp, "spec-off", sp_args,
+                    {"TEXTBLAST_SPECULATE": "off"},
+                )
+                on_rep, on_out, on_exc = _mh_pass(
+                    root, inp, "spec-on", sp_args
+                )
+                on_rate, on_s = _mh_rate(on_rep)
+                off_rate, off_s = _mh_rate(off_rep)
+                on_rows = (_mh_rows(on_out), _mh_rows(on_exc))
+                off_rows = (_mh_rows(off_out), _mh_rows(off_exc))
+                ids = set()
+                agree = 0
+                for side in (0, 1):
+                    by_id = {
+                        r["id"]: (side, r.get("text"), r.get("metadata"))
+                        for r in off_rows[side]
+                    }
+                    for r in on_rows[side]:
+                        ids.add(r["id"])
+                        if by_id.get(r["id"]) == (
+                            side, r.get("text"), r.get("metadata")
+                        ):
+                            agree += 1
+                    ids.update(by_id)
+                parity = agree / max(len(ids), 1)
+                on_res = on_rep.get("resilience", {})
+
+                def _stall(rep):
+                    return round(
+                        sum(
+                            h["metrics"].get(
+                                "multihost_window_stall_seconds_total", 0.0
+                            )
+                            for h in rep.get("hosts", [])
+                        ),
+                        3,
+                    )
+
+                def _posts(rep):
+                    return int(max(
+                        (h["metrics"].get("multihost_exchange_posts_total", 0)
+                         for h in rep.get("hosts", [])),
+                        default=0,
+                    ))
+
+                mh_speculate_report = {
+                    "speculate_docs_per_sec": round(on_rate, 2),
+                    "classic_docs_per_sec": round(off_rate, 2),
+                    "speedup": (
+                        round(on_rate / off_rate, 4) if off_rate else 0.0
+                    ),
+                    "decision_parity": round(parity, 6),
+                    "ordered_identical": on_rows == off_rows,
+                    "window_stall_s": {
+                        "speculate": _stall(on_rep),
+                        "classic": _stall(off_rep),
+                    },
+                    # Allgather posts per arm (max over hosts; lockstep, so
+                    # the rows agree).  The combined barrier post must put
+                    # the speculate arm strictly below classic on the file
+                    # transport — each saved post is a saved slot-file
+                    # round-trip.
+                    "exchange_posts": {
+                        "speculate": _posts(on_rep),
+                        "classic": _posts(off_rep),
+                    },
+                    "speculated_rounds": int(
+                        on_res.get("multihost_speculated_rounds_total", 0)
+                    ),
+                    "voided_rounds": int(
+                        on_res.get("multihost_voided_rounds_total", 0)
+                    ),
+                    "barrier_elisions": int(
+                        on_res.get("multihost_barrier_elisions_total", 0)
+                    ),
+                    "lockstep_s": {
+                        "speculate": round(on_s, 3),
+                        "classic": round(off_s, 3),
+                    },
+                    "n_docs": len(sp_docs),
+                    "processes": 2,
+                }
+                _log(
+                    f"speculative dispatch: {on_rate:.1f} docs/s vs "
+                    f"{off_rate:.1f} classic "
+                    f"(x{mh_speculate_report['speedup']}, "
+                    f"parity {parity:.4f}, "
+                    f"posts {_posts(on_rep)} vs {_posts(off_rep)}, "
+                    f"stall {_stall(on_rep)}s vs {_stall(off_rep)}s, "
+                    f"speculated="
+                    f"{mh_speculate_report['speculated_rounds']})"
+                )
+        except Exception as e:  # never bill a 2-proc spawn problem to the bench
+            mh_speculate_report = {"error": f"{type(e).__name__}: {e}"[:500]}
+            _log(f"speculative dispatch A/B skipped: {e}")
 
     # --- Exchange-transport A/B (BENCH_REFORM=1 enables; off by default —
     # four 2-proc runs).  Fault-free coordinated runs, the default XLA/KV
@@ -1692,6 +1840,13 @@ pipeline:
         # negotiated window depth, window stall seconds, and decision
         # parity between the arms (must be 1.0 — scheduling, not semantics).
         **({"multihost_overlap": mh_overlap_report} if mh_overlap_report else {}),
+        # Speculation on/off A/B through the 2-process coordinated path on
+        # the file-lease transport: lockstep docs/s both ways, window stall
+        # and exchange-post counts per arm (the barrier elision must show
+        # as strictly fewer posts), and an ordered-parity gate (must be
+        # 1.0 — speculation re-orders work, never decisions).
+        **({"multihost_speculate": mh_speculate_report}
+           if mh_speculate_report else {}),
         # KV-vs-file exchange-transport A/B (BENCH_REFORM=1): the fault-free
         # steady-state cost of the gang-reformation carrier, with ordered
         # output parity and a zero-reformation sanity gate.

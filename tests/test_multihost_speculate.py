@@ -1,0 +1,601 @@
+"""Speculative cross-phase dispatch tests (PR 18): joint rollback,
+phase-barrier elision, and on/off byte parity.
+
+Three layers:
+
+* **Unit** (tier-1): the 2-lane `_negotiate_depth` min rule (one host at
+  spec 0 pins the gang to the classic barrier) + 1-arg wire back-compat,
+  `negotiate_freight`'s combined verdict+freight post layout, phase
+  previewability as config-derived shared state, and the survivor preview
+  matching `assemble_phase` exactly.
+* **In-process** (tier-1): single-process `run_local_shard` with
+  speculation on vs off vs serial — byte-identical ordered outcome
+  streams fault-free, under an injected `multihost.round` fault in the
+  phase tail (the cross-barrier void must fire), and under a fault at the
+  `multihost.speculate` site itself.  Plus the sentinel guard: the knob
+  is scheduling-only, so `--check --counts-only` must stay PASS with it
+  set, and the drift note must name it.
+* **2-process** (slow): real coordinated CLI runs — speculation on vs
+  off byte-identical on the KV exchange path with speculated rounds and
+  barrier elisions in the merged report, and a one-host phase-tail fault
+  on the file-lease transport converging through the joint void with
+  `multihost_voided_rounds_total >= 1` in the merged report.
+
+The spawn helper is a standalone copy of tests/test_multihost.py's (same
+env contract) — importing across test modules would couple the suites.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from textblaster_tpu.config.pipeline import parse_pipeline_config
+from textblaster_tpu.data_model import TextDocument
+from textblaster_tpu.parallel import multihost as mh
+from textblaster_tpu.resilience import NegotiatedGuard
+from textblaster_tpu.resilience.faults import FAULTS
+from textblaster_tpu.utils.metrics import METRICS
+from textblaster_tpu.utils.trace import TRACER
+
+pytestmark = pytest.mark.speculate
+
+REPO = Path(__file__).parent.parent
+
+YAML = """
+pipeline:
+  - type: LanguageDetectionFilter
+    min_confidence: 0.5
+    allowed_languages: [ "dan", "eng" ]
+  - type: GopherRepetitionFilter
+    dup_line_frac: 0.3
+    top_n_grams: [[2, 0.25]]
+    dup_n_grams: [[5, 0.15]]
+  - type: GopherQualityFilter
+    min_doc_words: 4
+    min_stop_words: 1
+    stop_words: [ "og", "the", "er", "i" ]
+"""
+
+BADWORDS_YAML = """
+pipeline:
+  - type: C4BadWordsFilter
+    default_language: en
+    keep_fraction: 0.0
+    fail_on_missing_language: true
+"""
+
+
+@pytest.fixture(autouse=True)
+def _hygiene(monkeypatch):
+    # TRACER and FAULTS are process-global; leaked state would contaminate
+    # every later test in the session.  The speculation hatch is read per
+    # shard run, so pin it unset unless a test flips it.
+    monkeypatch.delenv("TEXTBLAST_SPECULATE", raising=False)
+    TRACER.close()
+    TRACER.drain()
+    FAULTS.reset()
+    yield
+    TRACER.close()
+    TRACER.drain()
+    FAULTS.reset()
+
+
+def _docs(n=48):
+    base = [
+        "Det er en god dag i dag, og vi skal ud at gå en lang tur i skoven nu.",
+        "The quick brown fox jumps over the lazy dog and the old stone bridge.",
+        "Samme linje her igen.\n" * 6,
+        "kort.",
+        "Endnu en dansk tekst om vejret, og den er ganske lang og fin at læse.",
+        "Vi mødes nede ved havnen i morgen, og så sejler vi ud på vandet.",
+    ]
+    rng = np.random.default_rng(7)
+    docs = []
+    for i in range(n):
+        t = base[i % len(base)]
+        if rng.random() < 0.25:
+            t = t + " Og lidt mere tekst til sidst her."
+        docs.append(TextDocument(id=f"sp-{i}", source="s", content=t))
+    return docs
+
+
+# --- 2-lane depth negotiation units ------------------------------------------
+
+
+def _fake_allgather(rows):
+    """host_allgather stand-in returning fixed per-host lane rows."""
+    arr = np.array(rows, dtype=np.int32)
+    if arr.ndim == 1:
+        arr = arr.reshape(-1, 1)
+    return lambda vec: arr
+
+
+def test_negotiate_depth_two_lane_min_rule(monkeypatch):
+    monkeypatch.setattr(
+        mh, "host_allgather", _fake_allgather([[3, 3], [2, 5], [4, 2]])
+    )
+    depth, spec = mh._negotiate_depth(3, 3)
+    assert (depth, spec) == (2, 2)
+    # Both joints are published as gauges for the merged run report.
+    assert METRICS.get("multihost_negotiated_depth") == 2.0
+    assert METRICS.get("multihost_speculate_depth") == 2.0
+
+
+def test_negotiate_depth_spec_zero_anywhere_pins_classic(monkeypatch):
+    # One host running TEXTBLAST_SPECULATE=off posts spec 0: the min rule
+    # turns speculation off for the whole gang (joint spec 0 selects the
+    # classic three-post barrier on every host identically).
+    monkeypatch.setattr(
+        mh, "host_allgather", _fake_allgather([[3, 3], [3, 0]])
+    )
+    depth, spec = mh._negotiate_depth(3, 3)
+    assert (depth, spec) == (3, 0)
+    assert METRICS.get("multihost_speculate_depth") == 0.0
+
+
+def test_negotiate_depth_one_arg_keeps_one_lane_wire(monkeypatch):
+    # The 1-arg form must stay a bare-int return over a 1-lane post —
+    # existing call sites and their wire traffic are untouched.
+    seen = {}
+
+    def gather(vec):
+        seen["width"] = int(np.asarray(vec).size)
+        return np.array([[3], [2]], dtype=np.int32)
+
+    monkeypatch.setattr(mh, "host_allgather", gather)
+    joint = mh._negotiate_depth(3)
+    assert joint == 2 and isinstance(joint, int)
+    assert seen["width"] == 1
+
+
+def test_negotiate_depth_spec_floor_is_zero(monkeypatch):
+    monkeypatch.setattr(mh, "host_allgather", _fake_allgather([[2, 0]]))
+    assert mh._negotiate_depth(2, -3) == (2, 0)
+
+
+# --- combined barrier post units ---------------------------------------------
+
+
+def _mk_guard():
+    from textblaster_tpu.config.pipeline import ResilienceConfig
+
+    rc = ResilienceConfig(
+        max_retries=2,
+        backoff_base_s=0.01,
+        backoff_max_s=1.0,
+        backoff_multiplier=2.0,
+        breaker_threshold=3,
+    )
+    return NegotiatedGuard(rc, buckets=(512,), sleep=lambda s: None)
+
+
+def test_negotiate_freight_layout(monkeypatch):
+    """ONE post carries [fault flags | freight lanes]; verdicts come back
+    OR-reduced in order and the freight rows come back raw per host."""
+    posted = {}
+
+    def gather(vec):
+        posted["vec"] = [int(x) for x in np.asarray(vec)]
+        # Two hosts: this one clean, the peer faulted on round 1, with
+        # different freight lanes (the caller reduces them).
+        return np.array(
+            [posted["vec"], [0, 1, 3, 9]], dtype=np.int64
+        )
+
+    monkeypatch.setattr(mh, "host_allgather", gather)
+    verdicts, rows = _mk_guard().negotiate_freight(
+        [False, False], [7, 5]
+    )
+    assert posted["vec"] == [0, 0, 7, 5]  # flags first, freight after
+    assert verdicts == [False, True]  # OR over hosts, round order kept
+    assert rows.shape == (2, 2)
+    assert rows[:, 0].tolist() == [7, 3] and rows[:, 1].tolist() == [5, 9]
+
+
+def test_negotiate_freight_books_batched_verdicts(monkeypatch):
+    monkeypatch.setattr(
+        mh, "host_allgather",
+        lambda vec: np.asarray(vec, dtype=np.int64).reshape(1, -1),
+    )
+    before = METRICS.get("resilience_negotiated_batched_verdicts_total")
+    _mk_guard().negotiate_freight([False, False, False], [4])
+    assert (
+        METRICS.get("resilience_negotiated_batched_verdicts_total")
+        == before + 3
+    )
+
+
+# --- survivor preview units --------------------------------------------------
+
+
+def test_phase_previewable_is_config_derived():
+    from textblaster_tpu.ops.pipeline import CompiledPipeline
+
+    pipeline = CompiledPipeline(
+        parse_pipeline_config(YAML), buckets=(512,), batch_size=8
+    )
+    assert len(pipeline.phases) == 2
+    # Every step here carries a full batch verdict mask.
+    assert pipeline.phase_previewable(0)
+    assert pipeline.phase_previewable(1)
+    # Badwords decides per row on the host (keep-fraction RNG,
+    # passed=None): its phase must never be previewed.
+    bad = CompiledPipeline(
+        parse_pipeline_config(BADWORDS_YAML), buckets=(512,), batch_size=8
+    )
+    assert not bad.phase_previewable(0)
+    with pytest.raises(AssertionError):
+        bad.preview_phase_survivors(None, {}, 0)
+
+
+def test_preview_matches_assemble_phase_exactly():
+    """The preview is the batch-vectorized half of assemble_phase: its
+    count must equal the survivors the real assembly produces, row for
+    row, on a mixed pass/fail batch."""
+    from textblaster_tpu.ops.packing import pack_documents
+    from textblaster_tpu.ops.pipeline import CompiledPipeline
+
+    pipeline = CompiledPipeline(
+        parse_pipeline_config(YAML), buckets=(512,), batch_size=8
+    )
+    docs = _docs(8)
+    batch = pack_documents(docs, batch_size=8, max_len=512)
+    for phase in (0, 1):
+        stats = pipeline.dispatch_batch(batch, phase=phase)
+        n = pipeline.preview_phase_survivors(batch, stats, phase)
+        _, survivors = pipeline.assemble_phase(batch, stats, phase)
+        if phase == len(pipeline.phases) - 1:
+            assert survivors == []  # final phase: outcomes, not survivors
+        else:
+            assert n == len(survivors)
+
+
+def test_speculate_knob_not_in_compile_cache_keys():
+    """Scheduling-only: TEXTBLAST_SPECULATE moves launches across phase
+    barriers but never changes a compiled program, so it must stay out of
+    the AOT cache key (flipping it must not recompile anything) while the
+    profiler's drift note still names it."""
+    from textblaster_tpu.utils import compile_cache, profiler
+
+    assert "TEXTBLAST_SPECULATE" not in compile_cache._TRACE_ENV_KNOBS
+    assert "TEXTBLAST_SPECULATE" in profiler._SCHEDULING_ENV_KNOBS
+
+
+def test_env_drift_note_names_speculate(monkeypatch):
+    from textblaster_tpu.utils.profiler import _env_drift_note
+
+    monkeypatch.setenv("TEXTBLAST_SPECULATE", "off")
+    # Baselines recorded before the knob existed carry no entry for it:
+    # the note must still name it (missing compares as the "" default).
+    notes = _env_drift_note({"env": {}})
+    assert any("TEXTBLAST_SPECULATE" in n for n in notes)
+    monkeypatch.delenv("TEXTBLAST_SPECULATE")
+    assert not any(
+        "TEXTBLAST_SPECULATE" in n for n in _env_drift_note({"env": {}})
+    )
+
+
+# --- in-process parity (single process, real device path) --------------------
+
+
+def _run_shard(config, docs, pipeline):
+    outs = mh.run_local_shard(
+        config, [d.copy() for d in docs], buckets=(512,), pipeline=pipeline
+    )
+    return [
+        (o.kind, o.document.id, o.document.content, o.document.metadata)
+        for o in outs
+    ]
+
+
+def _counters():
+    return {
+        k: METRICS.get(k)
+        for k in (
+            "multihost_speculated_rounds_total",
+            "multihost_voided_rounds_total",
+            "multihost_barrier_elisions_total",
+        )
+    }
+
+
+def _delta(before, after):
+    return {k: after[k] - before[k] for k in before}
+
+
+@pytest.fixture(scope="module")
+def _parity_rig():
+    """One compile for every in-process test in this module: the 3-step
+    config splits into phases [[0], [1, 2]] (both previewable) and 48
+    docs / batch 8 = 6 rounds per phase — enough plan depth for the
+    barrier to launch speculated rounds past the interior phase edge."""
+    from textblaster_tpu.ops.pipeline import CompiledPipeline
+
+    config = parse_pipeline_config(YAML)
+    docs = _docs(48)
+    pipeline = CompiledPipeline(config, buckets=(512,), batch_size=8)
+    config.overlap.enabled = False
+    serial = _run_shard(config, docs, pipeline)
+    assert len(serial) == len(docs)
+    config.overlap.enabled = True
+    config.overlap.pipeline_depth = 3
+    return config, docs, pipeline, serial
+
+
+def test_speculation_parity_inprocess_fault_free(_parity_rig, monkeypatch):
+    config, docs, pipeline, serial = _parity_rig
+    monkeypatch.setenv("TEXTBLAST_SPECULATE", "off")
+    before = _counters()
+    off = _run_shard(config, docs, pipeline)
+    assert off == serial  # ordered, content + metadata
+    d = _delta(before, _counters())
+    assert d["multihost_speculated_rounds_total"] == 0  # hatch respected
+    assert d["multihost_barrier_elisions_total"] == 0
+
+    monkeypatch.delenv("TEXTBLAST_SPECULATE")
+    before = _counters()
+    on = _run_shard(config, docs, pipeline)
+    assert on == serial
+    d = _delta(before, _counters())
+    assert d["multihost_speculated_rounds_total"] >= 1
+    assert d["multihost_voided_rounds_total"] == 0  # nothing faulted
+    assert d["multihost_barrier_elisions_total"] >= 1  # combined post
+    assert METRICS.get("multihost_speculate_depth") == 3.0
+
+
+@pytest.mark.chaos
+def test_phase_tail_fault_voids_speculation_with_parity(_parity_rig):
+    """A transient `multihost.round` fault in the phase-0 tail (round 5
+    of 6: speculated next-phase rounds are already in flight when its
+    verdict convenes) must void the speculated launches on the joint
+    verdict, re-dispatch them fresh, and still produce the serial
+    byte-identical stream."""
+    config, docs, pipeline, serial = _parity_rig
+    before = _counters()
+    TRACER.configure(None)
+    FAULTS.inject("multihost.round", OSError("tail blip"), after_calls=5)
+    try:
+        faulted = _run_shard(config, docs, pipeline)
+    finally:
+        FAULTS.reset()
+        TRACER.close()
+    assert faulted == serial
+    d = _delta(before, _counters())
+    assert d["multihost_voided_rounds_total"] >= 1
+    drained = [e for e in TRACER.drain() if e["name"] == "window_drained"]
+    causes = {e["args"].get("cause") for e in drained}
+    assert "speculation_void" in causes
+    # Voided instants carry the voided count; fault drains stay tagged.
+    assert any(
+        e["args"].get("voided", 0) >= 1
+        for e in drained
+        if e["args"].get("cause") == "speculation_void"
+    )
+
+
+@pytest.mark.chaos
+def test_speculate_site_fault_replays_with_parity(_parity_rig):
+    """A fault at the `multihost.speculate` site (the speculative launch
+    itself) marks the speculated round launch-faulted; its verdict
+    convenes at the round's adoption slot and the joint rollback must
+    re-dispatch it without disturbing the output stream."""
+    config, docs, pipeline, serial = _parity_rig
+    FAULTS.inject("multihost.speculate", OSError("speculate blip"))
+    try:
+        faulted = _run_shard(config, docs, pipeline)
+        fired = FAULTS.fired("multihost.speculate")
+    finally:
+        FAULTS.reset()
+    assert fired == 1  # the speculative launch really took the fault
+    assert faulted == serial
+
+
+# --- perf-sentinel guard -----------------------------------------------------
+
+
+def _clean_env(**extra):
+    env = {
+        k: v for k, v in os.environ.items() if not k.startswith("TEXTBLAST_")
+    }
+    env["TEXTBLAST_PALLAS_INTERPRET"] = "1"
+    env.update(extra)
+    return env
+
+
+@pytest.mark.profile
+def test_sentinel_counts_check_passes_with_speculation_on(tmp_path):
+    """Speculation re-times multi-host launches but must never change a
+    compiled program or its dispatch counts: the counts-only sentinel
+    check against the checked-in baseline must stay PASS with the knob
+    set (it is deliberately absent from the AOT cache key)."""
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "textblaster_tpu.utils.profiler",
+            "--check",
+            str(REPO / "profiles" / "sentinel_baseline.json"),
+            "--counts-only",
+        ],
+        env=_clean_env(
+            TEXTBLAST_SPECULATE="1",
+            TEXTBLAST_AOT_CACHE_DIR=str(tmp_path / "aot"),
+        ),
+        cwd=str(REPO),
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PASS" in proc.stdout
+
+
+# --- 2-process coordinated runs (slow) ---------------------------------------
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_cli(tmp_path, docs, yaml_text, timeout=560, per_proc_args=None,
+               extra_env=None, per_proc_env=None, tag="run"):
+    """Run the 2-process coordinated CLI; ``per_proc_env[pid]`` adds
+    rank-specific env (how exactly one rank gets a fault armed)."""
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(yaml_text, encoding="utf-8")
+    inp = tmp_path / "input.parquet"
+    if not inp.exists():
+        pq.write_table(
+            pa.table(
+                {
+                    "id": [d.id for d in docs],
+                    "text": [d.content for d in docs],
+                    "source": [d.source for d in docs],
+                }
+            ),
+            inp,
+        )
+    out = tmp_path / f"{tag}-kept.parquet"
+    exc = tmp_path / f"{tag}-excluded.parquet"
+    rep = tmp_path / f"{tag}-report.json"
+    port = _free_port()
+    procs = []
+    try:
+        for pid in (0, 1):
+            env = {
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+                "PATH": "/usr/bin:/bin:/usr/local/bin",
+                "HOME": "/root",
+            }
+            env.update(extra_env or {})
+            env.update((per_proc_env or {}).get(pid, {}))
+            procs.append(
+                subprocess.Popen(
+                    [
+                        sys.executable, "-m", "textblaster_tpu.cli", "run",
+                        "--coordinator", f"localhost:{port}",
+                        "--num-processes", "2",
+                        "--process-id", str(pid),
+                        "-i", str(inp),
+                        "-o", str(out),
+                        "-e", str(exc),
+                        "-c", str(cfg),
+                        "--buckets", "512,2048",
+                        # 48 local docs / 8 rows = 6 rounds per phase: deep
+                        # enough that the barrier has confirmed next-phase
+                        # chunks to speculate while tail verdicts resolve.
+                        "--device-batch", "8",
+                        "--run-report", str(rep),
+                        "--quiet",
+                        *(per_proc_args or {}).get(pid, ()),
+                    ],
+                    cwd=str(REPO),
+                    env=env,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT,
+                    text=True,
+                )
+            )
+        outputs = []
+        for p in procs:
+            o, _ = p.communicate(timeout=timeout)
+            outputs.append(o)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return procs, outputs, out, exc, rep
+
+
+def _rows(path):
+    return pq.read_table(path).to_pylist() if path.exists() else []
+
+
+@pytest.mark.slow
+def test_two_process_speculate_on_off_byte_identical_kv(tmp_path: Path):
+    """Speculation on (the default) vs TEXTBLAST_SPECULATE=off through
+    the real 2-process coordinated KV exchange path: output files must be
+    byte-identical, and the merged report must carry speculated rounds
+    and at least one barrier elision."""
+    docs = _docs(96)
+    procs, outputs, off_out, off_exc, _ = _spawn_cli(
+        tmp_path, docs, YAML, tag="spec-off",
+        per_proc_args={
+            0: ("--pipeline-depth", "3"),
+            1: ("--pipeline-depth", "3"),
+        },
+        extra_env={"TEXTBLAST_SPECULATE": "off"},
+    )
+    for p, o in zip(procs, outputs):
+        assert p.returncode == 0, o[-2000:]
+    procs, outputs, on_out, on_exc, rep = _spawn_cli(
+        tmp_path, docs, YAML, tag="spec-on",
+        per_proc_args={
+            0: ("--pipeline-depth", "3", "--speculate-depth", "3"),
+            1: ("--pipeline-depth", "3", "--speculate-depth", "3"),
+        },
+    )
+    for p, o in zip(procs, outputs):
+        assert p.returncode == 0, o[-2000:]
+    assert _rows(on_out) == _rows(off_out)  # ordered row-for-row identity
+    assert _rows(on_exc) == _rows(off_exc)
+    res = json.loads(rep.read_text(encoding="utf-8"))["resilience"]
+    assert res["multihost_speculate_depth"] == 3
+    assert res["multihost_speculated_rounds_total"] >= 1
+    assert res["multihost_barrier_elisions_total"] >= 1
+    assert res.get("multihost_voided_rounds_total", 0) == 0
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_two_process_phase_tail_fault_voids_on_file_transport(
+    tmp_path: Path,
+):
+    """A one-host `multihost.round` fault in the phase-0 tail on the
+    file-lease transport: the joint verdict voids the speculated
+    launches on BOTH hosts, they re-dispatch fresh, and the output is
+    byte-identical to fault-free serial — with the void visible in the
+    merged report."""
+    docs = _docs(96)
+    procs, outputs, s_out, s_exc, _ = _spawn_cli(
+        tmp_path, docs, YAML, tag="serial",
+        per_proc_args={
+            0: ("--no-overlap", "--exchange-transport", "file"),
+            1: ("--no-overlap", "--exchange-transport", "file"),
+        },
+    )
+    for p, o in zip(procs, outputs):
+        assert p.returncode == 0, o[-2000:]
+    procs, outputs, f_out, f_exc, rep = _spawn_cli(
+        tmp_path, docs, YAML, tag="faulted",
+        per_proc_args={
+            0: ("--pipeline-depth", "3", "--exchange-transport", "file"),
+            1: ("--pipeline-depth", "3", "--exchange-transport", "file"),
+        },
+        extra_env={
+            # Round 6 of 6 in phase 0 on rank 0 only: its verdict convenes
+            # at the barrier with speculated next-phase rounds in flight.
+            "TEXTBLAST_FAULTS": "multihost.round:after=5",
+            "TEXTBLAST_FAULTS_PROCESS": "0",
+        },
+    )
+    for p, o in zip(procs, outputs):
+        assert p.returncode == 0, o[-2000:]
+    assert _rows(f_out) == _rows(s_out)
+    assert _rows(f_exc) == _rows(s_exc)
+    res = json.loads(rep.read_text(encoding="utf-8"))["resilience"]
+    assert res["multihost_voided_rounds_total"] >= 1
+    assert res["resilience_negotiated_retries_total"] > 0

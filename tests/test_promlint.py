@@ -47,6 +47,12 @@ def _populated_registry() -> Metrics:
         m.observe_hdr("doc_latency_e2e_seconds", us)
     m.observe_hdr("doc_latency_write_seconds", 1_200)
     m.observe_hdr("exchange_post_latency_seconds", 850)
+    # Speculative cross-phase dispatch families: the three counters plus
+    # the negotiated-depth gauge, so their generated HELP/TYPE text lints.
+    m.inc("multihost_speculated_rounds_total", 5)
+    m.inc("multihost_voided_rounds_total", 2)
+    m.inc("multihost_barrier_elisions_total", 1)
+    m.set("multihost_speculate_depth", 3)
     # Device-profiling families: a per-(bucket, phase) dispatch-time HDR
     # histogram and its roofline achieved-bytes/s gauge.
     for us in (120, 3_500, 80_000):

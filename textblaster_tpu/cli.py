@@ -98,6 +98,14 @@ def build_parser() -> argparse.ArgumentParser:
                           "overlap.pipeline_depth, 2).  Higher values hide "
                           "more host time behind device compute at the cost "
                           "of one packed batch of host memory each")
+    run.add_argument("--speculate-depth", type=int, default=None,
+                     help="Multi-host only: next-phase rounds launched at "
+                          "each phase barrier before the tail verdicts "
+                          "resolve (default: the window depth).  The gang "
+                          "min-negotiates the value, so 0 on any host "
+                          "restores the classic three-post barrier for "
+                          "everyone — same as TEXTBLAST_SPECULATE=off.  "
+                          "Outputs are byte-identical at any depth")
     run.add_argument("--no-overlap", action="store_true",
                      help="Disable the overlapped host pipeline (reader "
                           "thread, pack pool, in-flight window, writer "
@@ -289,6 +297,12 @@ def _cmd_run(args: argparse.Namespace) -> int:
                   file=sys.stderr)
             return 1
         config.overlap.pipeline_depth = args.pipeline_depth
+    if args.speculate_depth is not None:
+        if args.speculate_depth < 0:
+            print(f"Invalid --speculate-depth value: {args.speculate_depth}",
+                  file=sys.stderr)
+            return 1
+        config.overlap.speculate_depth = args.speculate_depth
 
     buckets = None
     if args.buckets:
@@ -342,6 +356,10 @@ def _cmd_run(args: argparse.Namespace) -> int:
         "auto_geometry": bool(args.auto_geometry),
         "overlap_enabled": bool(config.overlap.enabled),
         "pipeline_depth": int(config.overlap.pipeline_depth),
+        "speculate_depth": (
+            None if config.overlap.speculate_depth is None
+            else int(config.overlap.speculate_depth)
+        ),
         "num_processes": args.num_processes,
         "doc_sample_rate": int(args.doc_sample_rate),
         "profile": bool(args.profile),

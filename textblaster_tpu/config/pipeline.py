@@ -383,6 +383,12 @@ class OverlapConfig:
     read_ahead: int = 4        # Parquet read-ahead queue, in read batches
     write_queue: int = 8       # writer-thread queue, in outcome batches
     overflow_flush: int = 64   # host-fallback docs buffered before a flush
+    # Multi-host speculative cross-phase dispatch: next-phase rounds this
+    # host will launch at a phase barrier before the tail verdicts resolve
+    # (--speculate-depth).  None follows pipeline_depth; 0 opts out, which
+    # min-negotiates the WHOLE gang onto the classic barrier — same as
+    # TEXTBLAST_SPECULATE=off.  Single-host runs ignore it.
+    speculate_depth: Optional[int] = None
 
     def validate(self) -> None:
         for name, val, lo in (
@@ -396,6 +402,11 @@ class OverlapConfig:
                 raise ConfigValidationError(
                     f"OverlapConfig: {name} must be >= {lo}, got {val}"
                 )
+        if self.speculate_depth is not None and self.speculate_depth < 0:
+            raise ConfigValidationError(
+                "OverlapConfig: speculate_depth must be >= 0 (0 disables "
+                f"speculation), got {self.speculate_depth}"
+            )
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "OverlapConfig":

@@ -1828,6 +1828,57 @@ class CompiledPipeline:
                 outcomes.append(outcome)
         return outcomes, survivors
 
+    def phase_previewable(self, phase: int) -> bool:
+        """True when every step of ``phase`` carries a full batch verdict
+        mask, so the phase's survivor count is derivable from device stats
+        alone (:meth:`preview_phase_survivors`).
+
+        Config-derived only — every lockstep host answers identically for
+        the same config, which is what lets the speculative phase barrier
+        (parallel/multihost.py) treat previewability as shared state
+        without exchanging it.  Badwords is out (per-row host regex +
+        keep-fraction RNG, ``passed=None``); C4 is out because its rewrite
+        re-routes survivors by post-rewrite length (and a non-final C4
+        phase is impossible anyway — the constructor collapses those)."""
+        return all(
+            self.device_steps[i].type in _PREVIEWABLE_STEPS
+            for i in self.phases[phase]
+        )
+
+    def preview_phase_survivors(
+        self,
+        batch: PackedBatch,
+        device_stats: Dict[str, jax.Array],
+        phase: int,
+    ) -> int:
+        """Exact survivor count for one resolved round of a previewable
+        non-final phase — the batch-vectorized half of
+        :meth:`assemble_phase` without any per-row work or side effects.
+
+        The speculative phase barrier posts these counts piggybacked on
+        the tail verdict exchange, so the next phase's round schedule can
+        be negotiated in the same allgather the tail flags ride.  A row
+        survives iff it overflowed no kernel table and passed every step
+        of the phase — identical to the rows ``assemble_phase`` appends to
+        ``survivors``, which the barrier asserts after assembly.  The
+        stats tree must already be host-side (``_timed_stats`` output);
+        evaluating it here and again in ``assemble_phase`` is safe because
+        the step finalizers are pure over the stats arrays."""
+        assert self.phase_previewable(phase), (
+            "preview_phase_survivors called on a non-previewable phase — "
+            "the barrier must gate on phase_previewable or hosts desync "
+            "on the exchange vector width"
+        )
+        stats = jax.device_get(device_stats)
+        n_rows = len(batch.docs)
+        mask = np.ones(n_rows, dtype=bool)
+        for i in self.phases[phase]:
+            ev = self._eval_step(self.device_steps[i], i, stats)
+            if ev.overflow is not None:
+                mask &= ~ev.overflow[:n_rows]
+            mask &= ev.passed[:n_rows]
+        return int(mask.sum())
+
     def assemble_batch(
         self, batch: PackedBatch, device_stats: Dict[str, jax.Array]
     ) -> List[ProcessingOutcome]:
@@ -2141,6 +2192,19 @@ class CompiledPipeline:
                 return ProcessingOutcome.filtered(doc, decision.reason)
         return None
 
+
+#: Step types whose batch eval always yields a full per-row verdict mask
+#: (``_StepEval.passed`` is an array, never None) — the set
+#: ``phase_previewable`` checks.  Badwords decides per-row on the host;
+#: C4 rewrites survivor content.
+_PREVIEWABLE_STEPS = frozenset(
+    {
+        "LanguageDetectionFilter",
+        "GopherRepetitionFilter",
+        "GopherQualityFilter",
+        "FineWebQualityFilter",
+    }
+)
 
 _EVALS = {
     "LanguageDetectionFilter": CompiledPipeline._eval_langid,

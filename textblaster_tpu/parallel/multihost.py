@@ -801,26 +801,54 @@ class FileLeaseTransport(ExchangeTransport):
         the election and the gang proceeds un-grown (no raise); a *member*
         death during the sweep folds into the ordinary reformation retry
         inside :func:`elect_members`."""
+        lanes = self.collect_join_lanes()
+        if lanes is None:
+            return
+        if len(self._members) == 1:
+            # Solo gang: nobody to agree with, the local view is the union.
+            union = [r for r in lanes if r >= 0]
+        else:
+            merged = self.allgather(np.asarray(lanes, dtype=np.int64))
+            union = [int(x) for x in np.asarray(merged).ravel()]
+        self.admit_union(union)
+
+    def collect_join_lanes(self) -> Optional[List[int]]:
+        """Local half of the admission sweep: the fixed-width join-lane row
+        this rank would post (observed joiner ranks, ``-1`` padding to
+        ``_JOIN_LANES``), split out of :meth:`maybe_admit` so the
+        speculative phase barrier can piggyback it on the combined
+        barrier exchange instead of spending a dedicated allgather.
+        Returns ``None`` when admission is off (no ``--survive-peer-loss``
+        — the caller then posts no admission lanes at all, keeping the
+        vector width identical on every host)."""
+        if not self.survive:
+            return None
+        local = sorted(
+            r for r in self.store.read_join_requests()
+            if r not in self._members
+        )[:_JOIN_LANES]
+        return local + [-1] * (_JOIN_LANES - len(local))
+
+    def admit_union(self, ranks) -> None:
+        """Gang half of the admission sweep: act on the agreed joiner set.
+
+        ``ranks`` is the flattened merge of every member's join lanes
+        (``-1`` padding and already-member ranks are filtered here, so
+        callers hand over raw allgather rows).  Every member reaches this
+        with the identical union — from :meth:`maybe_admit`'s own
+        allgather or from lanes piggybacked on the barrier exchange — so
+        either every member runs the admission election or none does.
+        Raises :exc:`GangReformed` on successful admission, exactly as
+        :meth:`maybe_admit` always did."""
         if not self.survive:
             return
         from ..resilience.faults import FAULTS
         from ..utils.metrics import METRICS
 
         epoch = _EXCHANGE.epoch
-        local = sorted(
-            r for r in self.store.read_join_requests()
-            if r not in self._members
-        )[:_JOIN_LANES]
-        if len(self._members) == 1:
-            # Solo gang: nobody to agree with, the local view is the union.
-            union = local
-        else:
-            row = local + [-1] * (_JOIN_LANES - len(local))
-            merged = self.allgather(np.asarray(row, dtype=np.int64))
-            union = sorted(
-                {int(x) for x in np.asarray(merged).ravel() if int(x) >= 0}
-                - set(self._members)
-            )
+        union = sorted(
+            {int(x) for x in ranks if int(x) >= 0} - set(self._members)
+        )
         if not union:
             return
         FAULTS.fire("multihost.join.admit")
@@ -1109,7 +1137,7 @@ def _negotiate_max(needed_local: np.ndarray) -> np.ndarray:
     return host_allgather(needed_local).max(axis=0).astype(np.int32)
 
 
-def _negotiate_depth(local_depth: int) -> int:
+def _negotiate_depth(local_depth: int, local_spec_depth: Optional[int] = None):
     """Joint in-flight window depth: the MIN over every host's configured
     ``OverlapConfig.pipeline_depth`` (one extra startup allgather, zero
     per-round exchanges).
@@ -1121,12 +1149,26 @@ def _negotiate_depth(local_depth: int) -> int:
     run ahead of unresolved verdicts and the most conservative host bounds
     what all hosts may assume about each other's dispatch order.  A
     mismatch is legal (hosts merely negotiate down) but surfaced in the
-    trace so an operator can see which rank capped the window."""
+    trace so an operator can see which rank capped the window.
+
+    With ``local_spec_depth`` the post carries a second lane — the
+    speculative cross-phase dispatch depth — negotiated by the same min
+    rule in the same allgather, and the return becomes ``(depth, spec)``.
+    Speculation is lockstep state for the same reason depth is: the
+    combined barrier exchange replaces the classic three-post phase
+    boundary, so every host must agree whether the protocol is on (joint
+    spec > 0) before the first barrier.  One host running with
+    ``TEXTBLAST_SPECULATE=off`` (local spec 0) therefore pins the whole
+    gang to the classic barrier.  The 1-arg form stays a 1-lane post
+    returning a bare int — existing call sites and their wire traffic are
+    untouched."""
     from ..utils.metrics import METRICS
 
-    depths = host_allgather(
-        np.array([max(1, int(local_depth))], dtype=np.int32)
-    )[:, 0]
+    lanes = [max(1, int(local_depth))]
+    if local_spec_depth is not None:
+        lanes.append(max(0, int(local_spec_depth)))
+    merged = host_allgather(np.array(lanes, dtype=np.int32))
+    depths = merged[:, 0]
     joint = max(1, int(depths.min()))
     METRICS.set("multihost_negotiated_depth", float(joint))
     if int(depths.max()) != joint:
@@ -1134,7 +1176,11 @@ def _negotiate_depth(local_depth: int) -> int:
             "window_depth_mismatch",
             {"host_depths": [int(d) for d in depths], "joint": joint},
         )
-    return joint
+    if local_spec_depth is None:
+        return joint
+    spec = max(0, int(merged[:, 1].min()))
+    METRICS.set("multihost_speculate_depth", float(spec))
+    return joint, spec
 
 
 def _align_trace_clocks() -> None:
@@ -1217,6 +1263,19 @@ def run_local_shard(
     fault verdict drains the window: every host discards its launched-ahead
     results and the younger rounds re-dispatch fresh at their own resolve,
     keeping the post-verdict global program order identical on every host.
+
+    Speculative cross-phase dispatch (this PR): at each non-final phase
+    barrier, up to ``spec_depth`` next-phase rounds launch before the tail
+    verdicts resolve (``launch_speculative``), and the tail verdict batch,
+    join-admission sweep, and next-phase schedule negotiation collapse
+    into ONE exchange post (``resolve_barrier`` — two on phases a badwords
+    step keeps from previewing).  The joint speculation depth is the min
+    over every host's local value (``--speculate-depth``, default the
+    window depth; ``TEXTBLAST_SPECULATE=off`` posts 0 and pins the whole
+    gang to the classic barrier).  Any joint fault voids the speculated
+    launches and the piggybacked freight identically on every host —
+    speculation moves launches, never outcomes, so on/off runs stay
+    byte-identical.
     """
     import os
     from collections import deque
@@ -1300,9 +1359,30 @@ def run_local_shard(
         and os.environ.get("TEXTBLAST_NO_OVERLAP") != "1"
     )
     local_depth = max(1, overlap_cfg.pipeline_depth) if overlapped else 1
+    # Local speculative cross-phase dispatch depth: how many next-phase
+    # rounds this host is willing to launch at a phase barrier before the
+    # tail verdicts resolve.  Defaults to the window depth; capped per-host
+    # by --speculate-depth and killed by TEXTBLAST_SPECULATE=off (or a
+    # single-phase pipeline, where there is no barrier to speculate
+    # across).  The joint value is min-negotiated alongside the window
+    # depth — one host opting out pins the whole gang to the classic
+    # three-post barrier, because the barrier protocol itself is lockstep
+    # state.
+    spec_env = os.environ.get("TEXTBLAST_SPECULATE", "").strip().lower()
+    spec_cfg = getattr(overlap_cfg, "speculate_depth", None)
+    if (
+        not overlapped
+        or spec_env in ("off", "0", "false")
+        or len(pipeline.phases) < 2
+    ):
+        local_spec = 0
+    elif spec_cfg is None:
+        local_spec = local_depth
+    else:
+        local_spec = max(0, int(spec_cfg))
     while True:
         try:
-            depth = _negotiate_depth(local_depth)
+            depth, spec_depth = _negotiate_depth(local_depth, local_spec)
             break
         except GangReformed:
             # The reformation already bumped the exchange epoch; just
@@ -1314,13 +1394,22 @@ def run_local_shard(
     # (--no-overlap) packs inline on this thread, exactly as before.
     pool = shared_pack_pool(max(1, overlap_cfg.pack_workers)) if overlapped else None
 
-    def launch(local, ph):
+    def launch(local, ph, speculative=False):
         """Guarded async launch.  Returns ``(out, launch_fault)``: a
         retryable launch failure is captured, not raised — the verdict has
-        to convene at resolve time so every host takes the same branch."""
+        to convene at resolve time so every host takes the same branch.
+        ``speculative`` marks a cross-phase launch fired at a phase
+        barrier before the tail verdicts resolved (its own chaos seam,
+        ``multihost.speculate``)."""
+        from ..resilience.faults import FAULTS
+
         if guard is None:
+            if speculative:
+                FAULTS.fire("multihost.speculate")
             return pipeline.dispatch_lockstep(local, ph, sh2, sh1), False
         try:
+            if speculative:
+                FAULTS.fire("multihost.speculate")
             return pipeline.dispatch_lockstep(local, ph, sh2, sh1), False
         except BaseException as e:  # noqa: BLE001 — classifier decides
             if classify_error(e) != "retryable":
@@ -1344,6 +1433,17 @@ def run_local_shard(
     # survivor chunks, keyed (bucket, round), built while this phase's tail
     # rounds are still resolving.
     prepack_next: dict = {}
+    # Speculative cross-phase dispatch (joint spec_depth > 0): entries
+    # ``{"batch", "out", "fault"}`` keyed (bucket, round) for next-phase
+    # rounds LAUNCHED at this phase's barrier, before the tail verdicts
+    # resolved.  Chunks are only speculated once fully confirmed (a full
+    # next_current chunk exists ⇒ its documents' phase membership is
+    # resolved); the optimism lives in the piggybacked round COUNTS, which
+    # include still-pending tail survivors and are voided with the
+    # launches on any joint fault.  ``carried_schedule`` hands the
+    # barrier-negotiated next-phase schedule across the phase edge.
+    spec_next: dict = {}
+    carried_schedule = None
     for phase in range(n_phases):
         # Exchange epochs advance with the negotiated phase sequence — a
         # piece of round state every process derives identically without
@@ -1362,6 +1462,14 @@ def run_local_shard(
         prepack_done = {b: 0 for b in buckets}
         inherited = prepack_next  # this phase's pre-packed chunks
         prepack_next = {}
+        # Speculative launches made FOR this phase at the previous barrier,
+        # and the schedule negotiated there (piggybacked on the combined
+        # barrier exchange) — both None'd out by a reformation, which
+        # replays through the classic negotiation instead.
+        spec_inflight = spec_next
+        spec_next = {}
+        carried = carried_schedule
+        carried_schedule = None
         reformed = False
         while True:
             plan: Optional[List[tuple]] = None
@@ -1373,24 +1481,37 @@ def run_local_shard(
                 # admission raises GangReformed into the handler below, so
                 # the re-entry re-negotiates the window depth over the
                 # grown gang exactly as a shrink reformation would.
-                maybe_admit_joiners()
-                if reformed:
-                    # Survivor re-entry: re-negotiate the window depth over
-                    # the reformed gang (a member with a different local
-                    # depth may have died).  Fault-free runs never take this
-                    # branch, so the exchange sequence they emit is
-                    # unchanged; the reformation itself already bumped the
-                    # exchange epoch, so no re-bump here.
-                    depth = _negotiate_depth(local_depth)
-                    reformed = False
-                needed_local = np.array(
-                    [
-                        math.ceil(len(current[b]) / local_for[b])
-                        for b in buckets
-                    ],
-                    dtype=np.int32,
-                )
-                schedule = _negotiate_max(needed_local)
+                if carried is not None:
+                    # The previous phase's speculative barrier already
+                    # negotiated this phase's schedule (round counts
+                    # piggybacked on the tail verdict post) and ran the
+                    # admission sweep off the same vector — re-posting
+                    # either here would break the lockstep exchange
+                    # sequence, since peers carried too.
+                    schedule = carried
+                    carried = None
+                else:
+                    maybe_admit_joiners()
+                    if reformed:
+                        # Survivor re-entry: re-negotiate the window depth
+                        # (and speculation depth) over the reformed gang (a
+                        # member with a different local depth may have
+                        # died).  Fault-free runs never take this branch,
+                        # so the exchange sequence they emit is unchanged;
+                        # the reformation itself already bumped the
+                        # exchange epoch, so no re-bump here.
+                        depth, spec_depth = _negotiate_depth(
+                            local_depth, local_spec
+                        )
+                        reformed = False
+                    needed_local = np.array(
+                        [
+                            math.ceil(len(current[b]) / local_for[b])
+                            for b in buckets
+                        ],
+                        dtype=np.int32,
+                    )
+                    schedule = _negotiate_max(needed_local)
                 if (
                     phase == 0
                     and rounds is not None
@@ -1436,6 +1557,12 @@ def run_local_shard(
                         if k in packs:
                             continue
                         kb, kr, kchunk = plan[k]
+                        if (kb, kr) in spec_inflight:
+                            # Speculatively launched at the previous
+                            # barrier: the packed batch lives in the spec
+                            # entry and is adopted at this round's launch
+                            # slot — packing it again would be pure waste.
+                            continue
                         pre = inherited.pop((kb, kr), None)
                         if pre is not None:
                             packs[k] = pre
@@ -1491,12 +1618,42 @@ def run_local_shard(
 
                 window: deque = deque()
 
+                def void_speculation():
+                    """Joint rollback of every speculative launch: this
+                    phase's not-yet-adopted entries and the next phase's
+                    barrier launches discard their results (the packed
+                    batches stay — chunk contents are final) and
+                    re-dispatch fresh, on every host identically, because
+                    the verdict that triggers the void is allgathered.
+                    The cross-barrier extension of the window drain's
+                    first-fault-authoritative contract."""
+                    n = sum(
+                        1
+                        for e in list(spec_inflight.values())
+                        + list(spec_next.values())
+                        if e["out"] is not None or e["fault"]
+                    )
+                    for e in list(spec_inflight.values()) + list(
+                        spec_next.values()
+                    ):
+                        e["out"] = None
+                        e["fault"] = False
+                    if n:
+                        METRICS.inc("multihost_voided_rounds_total", n)
+                        TRACER.instant(
+                            "window_drained",
+                            {"replayed": 0, "pending": 0, "voided": n,
+                             "phase": phase, "cause": "speculation_void"},
+                        )
+
                 def drain_window():
                     """Joint fault verdict convened at the window front:
                     discard this host's launched-ahead results so every
                     host's program order after the verdict is the same
                     ``[retry(r), r+1, ...]`` — the younger rounds
-                    re-dispatch fresh at their own resolve."""
+                    re-dispatch fresh at their own resolve.  Speculative
+                    launches are part of the launched-ahead state and void
+                    with the window."""
                     n = sum(
                         1 for e in window if e["out"] is not None or e["fault"]
                     )
@@ -1510,8 +1667,9 @@ def run_local_shard(
                     TRACER.instant(
                         "window_drained",
                         {"replayed": n, "pending": len(window),
-                         "phase": phase},
+                         "phase": phase, "cause": "fault"},
                     )
+                    void_speculation()
 
                 def resolve_front():
                     """Block for the OLDEST in-flight round and assemble it
@@ -1596,6 +1754,18 @@ def run_local_shard(
                         fault, st = bool(entry["fault"]), None
                         if not fault:
                             try:
+                                if entry["out"] is None:
+                                    # Voided by a mid-phase drain: nothing
+                                    # is in flight, so re-dispatch fresh at
+                                    # the resolve — the batched analogue of
+                                    # resolve_front's ``inflight=None``
+                                    # path (the voided set is joint, so
+                                    # every host re-dispatches the same
+                                    # rounds here, in the same order).
+                                    entry["out"] = pipeline.dispatch_lockstep(
+                                        entry["batch"], entry["phase"],
+                                        sh2, sh1,
+                                    )
                                 st = _timed_stats(
                                     entry["out"],
                                     entry["bucket"],
@@ -1666,6 +1836,332 @@ def run_local_shard(
                             absorb(eb, alive)
                             consumed[entry["plan_idx"]] = True
 
+                def launch_speculative():
+                    """Launch up to ``spec_depth`` of the NEXT phase's
+                    confirmed survivor chunks while this phase's tail
+                    verdicts are still unresolved — the device computes
+                    phase p+1 rounds across the barrier instead of idling
+                    through the drain.
+
+                    Only fully-confirmed chunks launch: a complete
+                    ``next_current`` chunk exists only once every document
+                    in it resolved its phase-p membership, so the LAUNCHED
+                    work is never optimistic — the optimism lives in the
+                    piggybacked round counts, which include still-pending
+                    tail survivors.  Per-host launch counts may differ
+                    (chunk confirmation progress is local); that is sound
+                    for the collective-free programs this build compiles,
+                    the same residual-risk stance resilience/negotiated.py
+                    documents for fetches.  Voided entries (``out=None``)
+                    re-launch here on the barrier's next pass, after the
+                    joint drain."""
+                    if spec_depth <= 0 or pool is None:
+                        return
+                    in_flight = sum(
+                        1 for e in spec_next.values()
+                        if e["out"] is not None or e["fault"]
+                    )
+                    for nb in buckets:
+                        if guard is not None and guard.bucket_degraded(nb):
+                            continue
+                        for k in range(prepack_done[nb]):
+                            if in_flight >= spec_depth:
+                                return
+                            key = (nb, k)
+                            e = spec_next.get(key)
+                            if e is None:
+                                fut = prepack_next.pop(key, None)
+                                if fut is None:
+                                    continue
+                                e = {
+                                    "batch": (
+                                        fut.result()
+                                        if hasattr(fut, "result")
+                                        else fut
+                                    ),
+                                    "out": None,
+                                    "fault": False,
+                                }
+                                spec_next[key] = e
+                            elif e["out"] is not None or e["fault"]:
+                                continue
+                            with TRACER.span(
+                                "lockstep_speculate",
+                                {"bucket": nb, "round": k,
+                                 "phase": phase + 1},
+                            ):
+                                out, fault = launch(
+                                    e["batch"], phase + 1, speculative=True
+                                )
+                            e["out"], e["fault"] = out, fault
+                            METRICS.inc(
+                                "multihost_speculated_rounds_total"
+                            )
+                            in_flight += 1
+
+                def resolve_barrier():
+                    """Speculative phase barrier: resolve the tail rounds,
+                    sweep join admission, and negotiate the next phase's
+                    schedule — all on ONE exchange post — with up to
+                    ``spec_depth`` next-phase rounds launched before the
+                    tail verdicts convene.
+
+                    The combined vector is ``[tail fault flags | join
+                    lanes | next-phase round counts]``; every section's
+                    presence is derived from shared state (guard
+                    configured, transport admission-capable, phase
+                    previewable), so the width is identical on every host.
+                    The counts are optimistic — each host projects its
+                    tail survivors via ``preview_phase_survivors`` — and
+                    the first-fault-authoritative contract extends across
+                    the barrier: ANY fault verdict voids the speculative
+                    launches AND the freight on every host, the faulted
+                    round re-enters the serial retry protocol
+                    (``prior_fault``), the remainder drains
+                    round-at-a-time, and the barrier re-posts fresh.
+                    Returns the negotiated next-phase schedule, carried
+                    into the next phase instead of its classic
+                    ``maybe_admit_joiners`` + ``_negotiate_max`` posts.
+                    Phases without a batch verdict mask (badwords) cannot
+                    preview: the schedule then posts separately after
+                    assembly — two posts instead of one, still never
+                    three."""
+                    previewable = (
+                        not rewrites and pipeline.phase_previewable(phase)
+                    )
+                    collect = getattr(
+                        _EXCHANGE.transport, "collect_join_lanes", None
+                    )
+                    while True:
+                        launch_speculative()
+                        n_tail = len(window)
+                        entries = [window.popleft() for _ in range(n_tail)]
+                        TRACER.counter("lockstep_window", 0)
+                        t0 = time.perf_counter()
+                        faults, stats_list = [], []
+                        for entry in entries:
+                            fault, st = bool(entry["fault"]), None
+                            if not fault:
+                                if guard is None:
+                                    st = _timed_stats(
+                                        entry["out"], entry["bucket"],
+                                        entry["phase"],
+                                        entry["batch"].batch_size,
+                                    )
+                                else:
+                                    try:
+                                        if entry["out"] is None:
+                                            # Voided by a mid-phase drain:
+                                            # re-dispatch fresh, jointly
+                                            # (see resolve_batch).
+                                            entry["out"] = (
+                                                pipeline.dispatch_lockstep(
+                                                    entry["batch"],
+                                                    entry["phase"],
+                                                    sh2, sh1,
+                                                )
+                                            )
+                                        st = _timed_stats(
+                                            entry["out"], entry["bucket"],
+                                            entry["phase"],
+                                            entry["batch"].batch_size,
+                                        )
+                                    except BaseException as e:  # noqa: BLE001
+                                        if classify_error(e) != "retryable":
+                                            raise
+                                        fault = True
+                            faults.append(fault)
+                            stats_list.append(st)
+                        proj = None
+                        counts = None
+                        if previewable:
+                            proj = {
+                                b: len(next_current[b]) for b in buckets
+                            }
+                            for i, entry in enumerate(entries):
+                                if not faults[i]:
+                                    proj[entry["bucket"]] += (
+                                        pipeline.preview_phase_survivors(
+                                            entry["batch"],
+                                            stats_list[i],
+                                            phase,
+                                        )
+                                    )
+                            counts = [
+                                math.ceil(proj[b] / local_for[b])
+                                for b in buckets
+                            ]
+                        lanes = collect() if collect is not None else None
+                        freight = (
+                            list(lanes) if lanes is not None else []
+                        ) + (counts if counts is not None else [])
+                        if guard is not None:
+                            verdicts, rows = guard.negotiate_freight(
+                                faults, freight
+                            )
+                            posts = 1
+                        elif freight:
+                            rows = host_allgather(
+                                np.asarray(freight, dtype=np.int64)
+                            )
+                            verdicts = [False] * n_tail
+                            posts = 1
+                        else:
+                            rows, verdicts, posts = None, [], 0
+                        METRICS.inc(
+                            "multihost_window_stall_seconds_total",
+                            time.perf_counter() - t0,
+                        )
+                        first = next(
+                            (i for i, v in enumerate(verdicts) if v), None
+                        )
+                        if first is not None:
+                            # Joint rollback: speculative launches and
+                            # piggybacked freight void together, on every
+                            # host (the counts were measured on tail state
+                            # the drain is about to discard).
+                            void_speculation()
+                            for k in range(first):
+                                entry = entries[k]
+                                eb = entry["bucket"]
+                                with TRACER.span(
+                                    "lockstep_resolve",
+                                    {"bucket": eb, "phase": phase},
+                                ):
+                                    guard.record_round_success(eb)
+                                    po, alive = pipeline.assemble_phase(
+                                        entry["batch"], stats_list[k],
+                                        phase,
+                                    )
+                                    outcomes.extend(po)
+                                    absorb(eb, alive)
+                                    consumed[entry["plan_idx"]] = True
+                            for e in reversed(entries[first + 1:]):
+                                window.appendleft(e)
+                            TRACER.counter("lockstep_window", len(window))
+                            entry = entries[first]
+                            local, eb = entry["batch"], entry["bucket"]
+                            with TRACER.span(
+                                "lockstep_resolve",
+                                {"bucket": eb, "phase": phase},
+                            ):
+                                stats = guard.run_round(
+                                    eb,
+                                    dispatch=lambda local=local: (
+                                        pipeline.dispatch_lockstep(
+                                            local, phase, sh2, sh1
+                                        )
+                                    ),
+                                    fetch=lambda out, eb=eb, rows_n=(
+                                        local.batch_size
+                                    ): _timed_stats(
+                                        out, eb, phase, rows_n
+                                    ),
+                                    on_fault=drain_window,
+                                    prior_fault=True,
+                                    prior_local_fault=faults[first],
+                                )
+                                if stats is None:
+                                    degraded.extend(local.docs)
+                                else:
+                                    po, alive = pipeline.assemble_phase(
+                                        local, stats, phase
+                                    )
+                                    outcomes.extend(po)
+                                    absorb(eb, alive)
+                                consumed[entry["plan_idx"]] = True
+                            while window:
+                                resolve_front()
+                            # Re-post a fresh barrier exchange: voided
+                            # speculative launches re-dispatch first, and
+                            # lanes/counts re-measure post-drain.
+                            continue
+                        for k, entry in enumerate(entries):
+                            eb = entry["bucket"]
+                            with TRACER.span(
+                                "lockstep_resolve",
+                                {"bucket": eb, "phase": phase},
+                            ):
+                                if guard is not None:
+                                    guard.record_round_success(eb)
+                                po, alive = pipeline.assemble_phase(
+                                    entry["batch"], stats_list[k], phase
+                                )
+                                outcomes.extend(po)
+                                absorb(eb, alive)
+                                consumed[entry["plan_idx"]] = True
+                        TRACER.instant(
+                            "window_drained",
+                            {"replayed": 0, "pending": 0, "phase": phase,
+                             "cause": "barrier"},
+                        )
+                        if proj is not None:
+                            for b in buckets:
+                                assert len(next_current[b]) == proj[b], (
+                                    f"bucket {b}: barrier preview "
+                                    f"projected {proj[b]} next-phase "
+                                    f"documents, assembly produced "
+                                    f"{len(next_current[b])} — "
+                                    "preview_phase_survivors drifted from "
+                                    "assemble_phase"
+                                )
+                        off = _JOIN_LANES if lanes is not None else 0
+                        if lanes is not None:
+                            # May raise GangReformed (admission) into the
+                            # phase handler — safe here: every tail round
+                            # above is consumed, so the replayed plan is
+                            # empty and the barrier re-runs over the grown
+                            # gang with fresh lanes.
+                            _EXCHANGE.transport.admit_union(
+                                [int(x) for x in rows[:, :off].ravel()]
+                            )
+                        if counts is not None:
+                            sched = (
+                                rows[:, off:off + len(buckets)]
+                                .max(axis=0)
+                                .astype(np.int32)
+                            )
+                        else:
+                            # A step without a batch verdict mask
+                            # (badwords) blocks the survivor preview: the
+                            # schedule needs post-assembly counts — one
+                            # extra post, still fewer than the classic
+                            # three.
+                            sched = _negotiate_max(
+                                np.array(
+                                    [
+                                        math.ceil(
+                                            len(next_current[b])
+                                            / local_for[b]
+                                        )
+                                        for b in buckets
+                                    ],
+                                    dtype=np.int32,
+                                )
+                            )
+                            posts += 1
+                        # Posts the classic barrier would have made: the
+                        # tail verdict batch, the admission sweep (only
+                        # when a multi-member gang runs one), and the
+                        # next-phase schedule.
+                        baseline = (
+                            (1 if guard is not None and n_tail >= 1 else 0)
+                            + (
+                                1
+                                if lanes is not None
+                                and rows is not None
+                                and rows.shape[0] > 1
+                                else 0
+                            )
+                            + 1
+                        )
+                        if baseline > posts:
+                            METRICS.inc(
+                                "multihost_barrier_elisions_total",
+                                baseline - posts,
+                            )
+                        return sched
+
                 for j, (b, r, chunk) in enumerate(plan):
                     if guard is not None and guard.bucket_degraded(b):
                         # Breaker latched on negotiated verdicts, so every
@@ -1680,6 +2176,21 @@ def run_local_shard(
                             {"bucket": b, "round": r, "phase": phase},
                         )
                         packs.pop(j, None)
+                        se = spec_inflight.pop((b, r), None)
+                        if se is not None and (
+                            se["out"] is not None or se["fault"]
+                        ):
+                            # Bucket latched between the speculative launch
+                            # and its adoption slot (a tail degradation at
+                            # the same barrier): the result is discarded
+                            # jointly, like any other voided speculation.
+                            METRICS.inc("multihost_voided_rounds_total")
+                            TRACER.instant(
+                                "window_drained",
+                                {"replayed": 0, "pending": 0, "voided": 1,
+                                 "phase": phase,
+                                 "cause": "speculation_void"},
+                            )
                         degraded.extend(chunk)
                         consumed[j] = True
                         continue
@@ -1689,12 +2200,28 @@ def run_local_shard(
                         {"bucket": b, "round": r, "phase": phase,
                          "rows": len(chunk)},
                     ):
-                        item = packs.pop(j)
-                        local = (
-                            item.result() if hasattr(item, "result") else item
-                        )
-                        record_occupancy(local)
-                        out, fault = launch(local, phase)
+                        se = spec_inflight.pop((b, r), None)
+                        if se is not None:
+                            # Adopt the speculative launch at its plan
+                            # slot: occupancy books here (once per round,
+                            # like every round), and a voided entry simply
+                            # re-dispatches fresh — byte-identical either
+                            # way, the speculation only moved the launch.
+                            local = se["batch"]
+                            record_occupancy(local)
+                            if se["out"] is None and not se["fault"]:
+                                out, fault = launch(local, phase)
+                            else:
+                                out, fault = se["out"], se["fault"]
+                        else:
+                            item = packs.pop(j)
+                            local = (
+                                item.result()
+                                if hasattr(item, "result")
+                                else item
+                            )
+                            record_occupancy(local)
+                            out, fault = launch(local, phase)
                     window.append({
                         "batch": local, "bucket": b, "phase": phase,
                         "out": out, "fault": fault, "plan_idx": j,
@@ -1702,7 +2229,15 @@ def run_local_shard(
                     TRACER.counter("lockstep_window", len(window))
                     while len(window) > depth:
                         resolve_front()
-                resolve_batch(len(window))
+                if not last and spec_depth > 0:
+                    carried_schedule = resolve_barrier()
+                else:
+                    resolve_batch(len(window))
+                    TRACER.instant(
+                        "window_drained",
+                        {"replayed": 0, "pending": 0, "phase": phase,
+                         "cause": "barrier"},
+                    )
                 break
             except GangReformed:
                 # Resume at the next round boundary over the survivor set:
@@ -1723,6 +2258,31 @@ def run_local_shard(
                 # abandoned plan's round numbering — drop them and pack
                 # fresh (futures are pure; unused results are garbage).
                 inherited = {}
+                # Speculative launches do not survive a reformation: the
+                # exchange epoch moved and the replayed plan renumbers its
+                # rounds.  Entries for THIS phase (keyed on the abandoned
+                # plan) drop entirely and re-pack fresh; entries for the
+                # next phase (keyed on persistent next_current chunk
+                # indexes) keep their packed batches and re-dispatch at
+                # the replayed barrier.
+                n_void = sum(
+                    1
+                    for e in list(spec_inflight.values())
+                    + list(spec_next.values())
+                    if e["out"] is not None or e["fault"]
+                )
+                if n_void:
+                    METRICS.inc("multihost_voided_rounds_total", n_void)
+                    TRACER.instant(
+                        "window_drained",
+                        {"replayed": 0, "pending": 0, "voided": n_void,
+                         "phase": phase, "cause": "speculation_void"},
+                    )
+                spec_inflight = {}
+                for e in spec_next.values():
+                    e["out"] = None
+                    e["fault"] = False
+                carried = None
                 reformed = True
         if last:
             break

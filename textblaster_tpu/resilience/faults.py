@@ -35,7 +35,12 @@ Sites planted in this build:
 * ``"multihost.join.admit"`` — per admission observation on the gang side
   (a member noticing a valid join request, on both the lockstep
   phase-boundary path and the ``--elastic`` loop — an armed fault makes
-  one member die mid-admission, folding into the reformation retry).
+  one member die mid-admission, folding into the reformation retry);
+* ``"multihost.speculate"`` — per speculative cross-phase launch at a
+  lockstep phase barrier (``run_local_shard``'s ``launch``
+  with ``speculative=True`` — an armed fault marks the speculated round
+  launch-faulted, so its verdict convenes at the round's adoption slot and
+  chaos tests can pin the joint-rollback/re-dispatch path).
 
 The injector is **inert by default**: with nothing armed, :meth:`fire` is a
 single attribute load + falsy check and keeps no per-call state, so

@@ -413,6 +413,35 @@ _SPECS: Dict[str, Tuple[str, str]] = {
         "Wall seconds inside host_allgather posts (transport round trip "
         "included), across all collectives this process joined",
     ),
+    # Speculative cross-phase dispatch (parallel/multihost.py
+    # resolve_barrier): next-phase rounds launch at each phase barrier
+    # before the tail verdicts resolve, and the barrier's three classic
+    # exchanges collapse into one post.  TEXTBLAST_SPECULATE=off /
+    # --speculate-depth 0 zeroes all four series.
+    "multihost_speculate_depth": (
+        "gauge",
+        "Joint speculative dispatch depth: the min over every host's "
+        "--speculate-depth (default: the window depth), allgathered with "
+        "the window depth at run start; 0 means the classic barrier",
+    ),
+    "multihost_speculated_rounds_total": (
+        "counter",
+        "Next-phase lockstep rounds launched at a phase barrier before "
+        "the tail verdicts resolved (includes re-launches after a void)",
+    ),
+    "multihost_voided_rounds_total": (
+        "counter",
+        "Speculative launches discarded by the joint rollback — a fault "
+        "verdict, bucket latch, or gang reformation voided the result and "
+        "the round re-dispatched fresh (outputs stay byte-identical)",
+    ),
+    "multihost_barrier_elisions_total": (
+        "counter",
+        "Exchange posts saved at phase barriers by piggybacking the tail "
+        "verdict batch, join-admission lanes, and next-phase round counts "
+        "into one combined post (largest win on the file transport, "
+        "where each post is a filesystem round-trip)",
+    ),
     # Overlapped-pipeline stage accounting (no reference equivalent).  The
     # counters are wall seconds spent *inside* each stage, summed across
     # worker threads; with overlap on, stages run concurrently, so the sum

@@ -548,7 +548,10 @@ def collect_sentinel_profile(
         "backend": jax.default_backend(),
         "buckets": list(buckets),
         "batch_size": int(batch_size),
-        "env": {k: os.environ.get(k, "") for k in _TRACE_ENV_KNOBS},
+        "env": {
+            k: os.environ.get(k, "")
+            for k in (*_TRACE_ENV_KNOBS, *_SCHEDULING_ENV_KNOBS)
+        },
         "cost_fingerprint": fp,
         "programs": programs,
     }
@@ -627,12 +630,25 @@ def compare_profiles(
     return status, findings
 
 
+#: Scheduling-only knobs the drift note also names: they must NEVER change
+#: per-(bucket, phase) dispatch counts (TEXTBLAST_SPECULATE moves multi-host
+#: launches across phase barriers, not programs), so they are deliberately
+#: NOT in compile_cache._TRACE_ENV_KNOBS — but if counts ever drift with one
+#: set, the note points straight at it instead of leaving a silent diff.
+_SCHEDULING_ENV_KNOBS = ("TEXTBLAST_SPECULATE", "TEXTBLAST_NO_OVERLAP")
+
+
 def _env_drift_note(base: Dict[str, object]) -> List[str]:
     """Informational lines when the check environment's trace-shaping
     knobs differ from the baseline's record — the usual root cause when
-    dispatch counts drift (e.g. TEXTBLAST_DEPFUSE=off)."""
+    dispatch counts drift (e.g. TEXTBLAST_DEPFUSE=off).  Scheduling knobs
+    absent from older baselines compare against "" (their recorded-empty
+    default), so no baseline regeneration is needed to get them named."""
     notes = []
-    for k, bv in sorted(dict(base.get("env", {})).items()):
+    env = dict(base.get("env", {}))
+    for k in _SCHEDULING_ENV_KNOBS:
+        env.setdefault(k, "")
+    for k, bv in sorted(env.items()):
         cv = os.environ.get(k, "")
         if cv != bv:
             notes.append(f"NOTE env {k}={cv!r} (baseline recorded {bv!r})")
