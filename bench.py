@@ -1129,6 +1129,49 @@ def main() -> int:
             f"{resilience_report['degraded_rounds']} degraded)"
         )
 
+    # --- Stall-watchdog on/off A/B (BENCH_WATCHDOG=0 skips).  The armed
+    # arm runs with a generous per-stage deadline (nothing actually stalls,
+    # so the watchdog only pays its readiness polls / bounded queue waits);
+    # the disarmed arm is the default zero-cost path.  Parity must be 1.0 —
+    # the deadline is scheduling-only — and the overhead should sit within
+    # run-to-run noise.
+    watchdog_report = None
+    if os.environ.get("BENCH_WATCHDOG", "1") != "0":
+        from textblaster_tpu.resilience.watchdog import WATCHDOG
+
+        try:
+            stalls_before = METRICS.get("watchdog_stalls_total")
+            wd_off_rate, wd_off_out = _kernel_pass(pipeline)
+            WATCHDOG.configure(120.0)
+            try:
+                wd_on_rate, wd_on_out = _kernel_pass(pipeline)
+            finally:
+                WATCHDOG.reset()
+            wd_on_by_id = {o.document.id: o.kind for o in wd_on_out}
+            wd_off_by_id = {o.document.id: o.kind for o in wd_off_out}
+            wd_parity = sum(
+                1 for k, v in wd_off_by_id.items() if wd_on_by_id.get(k) == v
+            ) / max(len(wd_off_by_id), 1)
+            watchdog_report = {
+                "on_docs_per_sec": round(wd_on_rate, 2),
+                "off_docs_per_sec": round(wd_off_rate, 2),
+                "overhead_frac": round(1.0 - wd_on_rate / wd_off_rate, 4),
+                "parity": round(wd_parity, 6),
+                "stalls": int(
+                    METRICS.get("watchdog_stalls_total") - stalls_before
+                ),
+            }
+            _log(
+                f"watchdog A/B: {wd_on_rate:.1f} docs/s armed vs "
+                f"{wd_off_rate:.1f} disarmed "
+                f"(overhead {watchdog_report['overhead_frac']:+.2%}, "
+                f"parity {wd_parity:.4f}, "
+                f"stalls {watchdog_report['stalls']})"
+            )
+        except Exception as e:  # never bill a watchdog A/B problem to the bench
+            watchdog_report = {"error": str(e)}
+            _log(f"watchdog A/B skipped: {e}")
+
     # --- Multi-host overlap A/B (BENCH_MULTIHOST_OVERLAP=0 skips).  Real
     # 2-process coordinated CLI runs on the local box: overlapped lockstep
     # window (--pipeline-depth 3) vs serial (--no-overlap --pipeline-depth 1),
@@ -1835,6 +1878,10 @@ pipeline:
         # Fault-free A/B of the negotiated multi-host fault guard (docs/s
         # with the per-round verdict protocol on vs off) + its counters.
         **({"resilience": resilience_report} if resilience_report else {}),
+        # Stall-watchdog armed/disarmed A/B (generous deadline, nothing
+        # stalls): parity must be 1.0 and the armed overhead within noise —
+        # the disarmed default pays one attribute check per seam.
+        **({"watchdog": watchdog_report} if watchdog_report else {}),
         # Overlapped-vs-serial multi-host lockstep A/B (2 coordinated
         # processes on this box): lockstep-section docs/s both ways, the
         # negotiated window depth, window stall seconds, and decision

@@ -279,9 +279,15 @@ def test_fold_divergent_patterns_disqualify():
     # stay compiled with a per-list hazard set (ADVICE r4 / _fold_partners).
     assert BadwordTables.build(["\u017ftop"], check_boundaries=True) is None
     assert BadwordTables.build(["\u0130stanbul"], check_boundaries=True) is None
-    # Greek sigma's partner is final sigma (rare side) -> compiled + hazard.
-    t = BadwordTables.build(["\u03c3\u03c0\u03b1\u03bc"], check_boundaries=True)
-    assert t is not None and 0x3C2 in t.hazard_cps
+    # Greek sigma's partner is final sigma (U+03C2) \u2014 formally un-cased-to,
+    # but it ends nearly every Greek word, so it is treated as COMMON:
+    # hazard-flagging it would silently host-re-decide almost every Greek
+    # row under "device" attribution.  The honest shape is the whole-list
+    # host fallback, like the long-s/dotted-I divergences above.
+    assert (
+        BadwordTables.build(["\u03c3\u03c0\u03b1\u03bc"], check_boundaries=True)
+        is None
+    )
     # Kelvin sign lowers to 'k' in one char -- the table expresses it fine,
     # and an s/i-free pattern has no hazard at all.
     t = BadwordTables.build(["kelvon"], check_boundaries=True)

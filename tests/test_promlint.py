@@ -53,6 +53,14 @@ def _populated_registry() -> Metrics:
     m.inc("multihost_voided_rounds_total", 2)
     m.inc("multihost_barrier_elisions_total", 1)
     m.set("multihost_speculate_depth", 3)
+    # Stall-watchdog families: stall/escalation counters plus the per-stage
+    # deadline gauges published when --stage-deadline-s is armed.
+    m.inc("watchdog_stalls_total", 2)
+    m.inc("watchdog_escalations_total", 1)
+    m.set("watchdog_deadline_seconds_device_fetch", 30.0)
+    m.set("watchdog_deadline_seconds_pack_wait", 30.0)
+    m.set("watchdog_deadline_seconds_write_queue", 30.0)
+    m.set("watchdog_deadline_seconds_read_prefetch", 30.0)
     # Device-profiling families: a per-(bucket, phase) dispatch-time HDR
     # histogram and its roofline achieved-bytes/s gauge.
     for us in (120, 3_500, 80_000):

@@ -1,0 +1,703 @@
+"""Stall watchdog tests (PR 19): latency/hang fault kinds, per-stage
+deadlines, and graceful escalation through the existing recovery ladder.
+
+Four layers:
+
+* **Unit** (tier-1): `StallError` shape, `StageWatchdog` configuration and
+  the bounded-wait primitives (poll wait, queue get/put, progress-aware
+  thread join), the thread-local stage beat, and the fault injector's new
+  `delay`/`hang` kinds — a hang is rescued by the beat deadline on its own
+  thread and unblocked by a disarm from another thread.
+* **Grammar** (tier-1): `arm_from_env` parses exception-only specs exactly
+  as the pre-latency grammar did, and rejects mixed-kind entries naming
+  the offending entry.
+* **In-process chaos** (tier-1): `device.execute:hang` at pipeline depth 3
+  is byte-identical to fault-free — the stall surfaces as a typed error,
+  classifies retryable, and rides the retry → split → host ladder — with
+  `watchdog_stalls_total`/`watchdog_escalations_total` advancing.  Plus
+  the inertness guard (a disabled watchdog never constructs a beat or
+  bounded wait) and the scheduling-only knob guards (absent from AOT
+  cache keys, named in the profiler env-drift note, counts-only sentinel
+  stays PASS).
+* **2-process chaos** (slow): one rank's device dispatch wedged via
+  `TEXTBLAST_FAULTS=device.execute:hang` through real coordinated CLI
+  runs on the KV and file-lease transports — merged outputs byte-identical
+  to fault-free, stall visible in the merged run report.
+
+The spawn helper is a standalone copy of tests/test_multihost.py's (same
+env contract) — importing across test modules would couple the suites.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import socket
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from textblaster_tpu.config.pipeline import parse_pipeline_config
+from textblaster_tpu.data_model import TextDocument
+from textblaster_tpu.errors import StallError
+from textblaster_tpu.parallel.runner import run_pipeline
+from textblaster_tpu.resilience.faults import FAULTS, FaultInjector, arm_from_env
+from textblaster_tpu.resilience.retry import classify_error
+from textblaster_tpu.resilience.watchdog import (
+    ENV_KNOB,
+    STAGES,
+    WATCHDOG,
+    StageWatchdog,
+)
+from textblaster_tpu.utils.metrics import METRICS
+from textblaster_tpu.utils.trace import TRACER
+
+pytestmark = pytest.mark.watchdog
+
+REPO = Path(__file__).parent.parent
+
+CONFIG_YAML = """
+pipeline:
+  - type: GopherQualityFilter
+    min_doc_words: 5
+resilience:
+  backoff_base_s: 0.0
+  backoff_max_s: 0.0
+  breaker_threshold: 2
+"""
+
+GOOD = (
+    "This is a sentence with a number of words that is long enough to pass "
+    "the filter easily today."
+)
+BAD = "too short"
+BUCKETS = (512, 2048)
+
+
+@pytest.fixture(autouse=True)
+def _hygiene(monkeypatch):
+    # WATCHDOG, FAULTS, and TRACER are process-global; leaked arming would
+    # contaminate every later test in the session.
+    monkeypatch.delenv(ENV_KNOB, raising=False)
+    FAULTS.reset()
+    WATCHDOG.reset()
+    TRACER.close()
+    TRACER.drain()
+    yield
+    FAULTS.reset()
+    WATCHDOG.reset()
+    TRACER.close()
+    TRACER.drain()
+
+
+# --- StallError / configuration ----------------------------------------------
+
+
+def test_stall_error_shape_and_classification():
+    e = StallError("device_fetch", elapsed_s=3.21, deadline_s=3.0, detail="x")
+    assert (e.stage, e.deadline_s, e.detail) == ("device_fetch", 3.0, "x")
+    assert e.elapsed_s == pytest.approx(3.21)
+    msg = str(e)
+    assert "device_fetch" in msg and "3.2s" in msg and "3.0s" in msg and "(x)" in msg
+    # Retryable by construction: a stall must enter the retry -> split ->
+    # host ladder exactly like a raised transient fault.
+    assert classify_error(e) == "retryable"
+
+
+def test_configure_arms_and_publishes_deadline_gauges():
+    wd = StageWatchdog()
+    assert wd.enabled is False
+    assert wd.deadline_for("device_fetch") == 0.0
+    wd.configure(12.0, per_stage={"write_queue": 30.0})
+    assert wd.enabled is True
+    assert wd.deadline_for("device_fetch") == 12.0
+    assert wd.deadline_for("write_queue") == 30.0
+    for stage in STAGES:
+        want = 30.0 if stage == "write_queue" else 12.0
+        assert METRICS.get("watchdog_deadline_seconds_" + stage) == want
+    wd.reset()
+    assert wd.enabled is False and wd.deadline_for("write_queue") == 0.0
+
+
+def test_configure_from_env_and_invalid_values():
+    wd = StageWatchdog()
+    wd.configure_from_env({ENV_KNOB: "7.5"})
+    assert wd.enabled is True and wd.deadline_for("pack_wait") == 7.5
+    # Unset / blank / garbage leave the current configuration alone.
+    wd.configure_from_env({})
+    wd.configure_from_env({ENV_KNOB: "  "})
+    wd.configure_from_env({ENV_KNOB: "soon"})
+    assert wd.enabled is True and wd.deadline_for("pack_wait") == 7.5
+
+
+def test_negative_stage_deadline_rejected_by_config():
+    from textblaster_tpu.errors import ConfigValidationError
+
+    with pytest.raises(ConfigValidationError, match="stage_deadline_s"):
+        parse_pipeline_config(
+            CONFIG_YAML + "  stage_deadline_s: -1.0\n"
+        ).resilience.validate()
+
+
+# --- bounded-wait primitives -------------------------------------------------
+
+
+def test_wait_returns_when_done_and_stalls_at_deadline():
+    wd = StageWatchdog()
+    wd.configure(0.15)
+    wd.wait("device_fetch", lambda: True)  # immediate
+    with pytest.raises(StallError) as ei:
+        wd.wait("device_fetch", lambda: False, lambda: "2 arrays in flight")
+    assert ei.value.stage == "device_fetch"
+    assert ei.value.elapsed_s >= 0.15
+    assert "2 arrays in flight" in str(ei.value)
+    # Unbounded stage: returns at once so callers fall through to their
+    # ordinary blocking wait.
+    wd.configure(0.0)
+    wd.wait("device_fetch", lambda: False)
+
+
+def test_queue_get_put_bounded():
+    wd = StageWatchdog()
+    wd.configure(0.15)
+    q: "queue.Queue" = queue.Queue(maxsize=1)
+    with pytest.raises(StallError) as ei:
+        wd.queue_get("read_prefetch", q)
+    assert "queue depth 0" in ei.value.detail
+    q.put("a")
+    assert wd.queue_get("read_prefetch", q) == "a"
+    q.put("full")
+    with pytest.raises(StallError) as ei:
+        wd.queue_put("write_queue", q, "b")
+    assert ei.value.stage == "write_queue"
+    assert "queue depth 1" in ei.value.detail
+
+
+def test_join_thread_restarts_timer_on_progress():
+    # A slow-but-live drain (progress keeps moving) is never killed even
+    # though it outlives the per-stage deadline several times over.
+    wd = StageWatchdog()
+    wd.configure(0.2)
+    depth = [10]
+
+    def drain():
+        while depth[0] > 0:
+            time.sleep(0.05)
+            depth[0] -= 1
+
+    t = threading.Thread(target=drain)
+    t.start()
+    wd.join_thread("write_queue", t, lambda: depth[0])
+    assert not t.is_alive() and depth[0] == 0
+
+    # A wedged thread (no progress) surfaces the typed stall with depth.
+    stop = threading.Event()
+    t2 = threading.Thread(target=stop.wait)
+    t2.start()
+    try:
+        with pytest.raises(StallError) as ei:
+            wd.join_thread("write_queue", t2, lambda: 7)
+        assert "queue depth 7" in ei.value.detail
+    finally:
+        stop.set()
+        t2.join()
+
+
+# --- latency fault kinds -----------------------------------------------------
+
+
+def test_injected_delay_proceeds_when_shorter_than_deadline():
+    WATCHDOG.configure(10.0)
+    FAULTS.inject("x.site", kind="delay", delay_ms=60)
+    with WATCHDOG.stage_beat("device_fetch"):
+        t0 = time.monotonic()
+        FAULTS.fire("x.site")  # sleeps, then the seam proceeds normally
+    assert time.monotonic() - t0 >= 0.06
+    assert FAULTS.fired("x.site") == 1
+    FAULTS.fire("x.site")  # exhausted: inert again
+
+
+def test_injected_delay_longer_than_deadline_stalls():
+    WATCHDOG.configure(0.15)
+    FAULTS.inject("x.site", kind="delay", delay_ms=60_000)
+    before = METRICS.get("watchdog_stalls_total")
+    with WATCHDOG.stage_beat("device_fetch"):
+        with pytest.raises(StallError) as ei:
+            FAULTS.fire("x.site")
+    assert ei.value.stage == "device_fetch"
+    assert "injected delay at x.site" in ei.value.detail
+    assert METRICS.get("watchdog_stalls_total") == before + 1
+
+
+def test_injected_hang_rescued_by_stage_deadline():
+    WATCHDOG.configure(0.2)
+    FAULTS.inject("x.site", kind="hang")
+    t0 = time.monotonic()
+    with WATCHDOG.stage_beat("device_fetch"):
+        with pytest.raises(StallError) as ei:
+            FAULTS.fire("x.site")
+    assert time.monotonic() - t0 >= 0.2
+    assert ei.value.stage == "device_fetch"
+    assert "injected hang at x.site" in ei.value.detail
+
+
+def test_injected_hang_unblocked_by_disarm_from_another_thread():
+    # Without a watchdog beat the hang models a true wedge; FAULTS.reset()
+    # from another thread (test teardown, supervisor) must release it.
+    FAULTS.inject("x.site", kind="hang")
+    released = threading.Event()
+
+    def seam():
+        FAULTS.fire("x.site")
+        released.set()
+
+    t = threading.Thread(target=seam)
+    t.start()
+    time.sleep(0.1)
+    assert not released.is_set()
+    FAULTS.reset()
+    t.join(timeout=5)
+    assert released.is_set()
+
+
+def test_inject_kind_validation():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FAULTS.inject("x", kind="explode", exc=OSError("x"))
+    with pytest.raises(ValueError, match="requires exc"):
+        FAULTS.inject("x", kind="raise")
+    with pytest.raises(ValueError, match="delay_ms > 0"):
+        FAULTS.inject("x", kind="delay", delay_ms=0)
+
+
+def test_escalated_counts_only_stall_errors():
+    before = METRICS.get("watchdog_escalations_total")
+    WATCHDOG.escalated(OSError("transient"))
+    assert METRICS.get("watchdog_escalations_total") == before
+    WATCHDOG.escalated(StallError("pack_wait", elapsed_s=1.0, deadline_s=1.0))
+    assert METRICS.get("watchdog_escalations_total") == before + 1
+
+
+# --- arm_from_env grammar ----------------------------------------------------
+
+
+def _armed(inj, site):
+    return inj._sites[site]
+
+
+def test_arm_from_env_exception_only_specs_parse_as_before():
+    """Back-compat: specs from the pre-latency grammar must arm exactly
+    what they always did — kind 'raise', same counters, same allowlisted
+    exception types, OSError default."""
+    inj = FaultInjector()
+    n = arm_from_env(
+        {"TEXTBLAST_FAULTS": "read.batch;multihost.round:after=1:times=2:exc=TimeoutError"},
+        injector=inj,
+    )
+    assert n == 2
+    (f,) = _armed(inj, "read.batch")
+    assert (f.kind, f.after_calls, f.times, f.delay_ms) == ("raise", 0, 1, 0.0)
+    assert isinstance(f.make_exc(), OSError)
+    (g,) = _armed(inj, "multihost.round")
+    assert (g.kind, g.after_calls, g.times) == ("raise", 1, 2)
+    assert isinstance(g.make_exc(), TimeoutError)
+    assert "injected fault at multihost.round" in str(g.make_exc())
+
+
+def test_arm_from_env_latency_kinds():
+    inj = FaultInjector()
+    n = arm_from_env(
+        {"TEXTBLAST_FAULTS": "device.execute:hang:after=2;read.batch:delay=250:times=3"},
+        injector=inj,
+    )
+    assert n == 2
+    (h,) = _armed(inj, "device.execute")
+    assert (h.kind, h.after_calls, h.times) == ("hang", 2, 1)
+    (d,) = _armed(inj, "read.batch")
+    assert (d.kind, d.delay_ms, d.times) == ("delay", 250.0, 3)
+
+
+@pytest.mark.parametrize(
+    "spec",
+    [
+        "device.execute:exc=OSError:hang",
+        "device.execute:exc=OSError:delay=5",
+        "device.execute:delay=5:hang",
+    ],
+)
+def test_arm_from_env_rejects_mixed_kinds_naming_entry(spec):
+    with pytest.raises(ValueError, match="mutually exclusive") as ei:
+        arm_from_env({"TEXTBLAST_FAULTS": spec}, injector=FaultInjector())
+    assert spec in str(ei.value)
+
+
+def test_arm_from_env_rejects_bad_latency_values():
+    with pytest.raises(ValueError, match="delay must be > 0"):
+        arm_from_env(
+            {"TEXTBLAST_FAULTS": "x:delay=0"}, injector=FaultInjector()
+        )
+    with pytest.raises(ValueError, match="hang takes no value"):
+        arm_from_env(
+            {"TEXTBLAST_FAULTS": "x:hang=2"}, injector=FaultInjector()
+        )
+
+
+# --- in-process chaos: hang at depth 3 ---------------------------------------
+
+
+def _write_corpus(path, n=300):
+    texts = []
+    for i in range(n):
+        k = i % 7
+        if k == 0:
+            texts.append(BAD)
+        elif k == 1:
+            texts.append("")
+        elif k == 2:
+            texts.append(GOOD + " 😀 blåbærgrød " + "é" * (i % 11))
+        elif k == 3:
+            texts.append((GOOD + " ") * 25)  # over-length: host fallback
+        else:
+            texts.append(GOOD + f" extra words number {i}.")
+    pq.write_table(
+        pa.table({"id": [f"doc-{i}" for i in range(n)], "text": texts}), path
+    )
+
+
+def _config(depth=None):
+    config = parse_pipeline_config(CONFIG_YAML)
+    if depth is not None:
+        config.overlap.pipeline_depth = depth
+    return config
+
+
+def _run(tmp_path, tag, config, inp, n_docs=None):
+    kept = str(tmp_path / f"kept-{tag}.parquet")
+    excl = str(tmp_path / f"excl-{tag}.parquet")
+    errs = str(tmp_path / f"errs-{tag}.parquet")
+    result = run_pipeline(
+        config=config,
+        input_file=inp,
+        output_file=kept,
+        excluded_file=excl,
+        backend="tpu",
+        read_batch_size=64,
+        device_batch=32,
+        buckets=BUCKETS,
+        quiet=True,
+        errors_file=errs,
+    )
+    if n_docs is not None:
+        assert result.received == n_docs
+    return kept, excl, errs, result
+
+
+def _table_key(path):
+    t = pq.read_table(path).to_pylist()
+    rows = {r["id"]: r for r in t}
+    assert len(rows) == len(t), "duplicate ids in output"
+    return rows
+
+
+@pytest.mark.chaos
+def test_device_hang_at_depth_matches_fault_free(tmp_path):
+    """A wedged device dispatch with three batches in flight: the stage
+    deadline converts the hang into a typed StallError, the stall rides
+    the ordinary retry ladder, and the kept/excluded/dead-letter files are
+    byte-identical to fault-free — with the stall and its escalation both
+    visible in the metrics."""
+    inp = str(tmp_path / "in.parquet")
+    n = 300
+    _write_corpus(inp, n)
+
+    clean = _run(tmp_path, "clean", _config(depth=3), inp, n)
+
+    stalls_before = METRICS.get("watchdog_stalls_total")
+    esc_before = METRICS.get("watchdog_escalations_total")
+    WATCHDOG.configure(0.4)
+    FAULTS.inject("device.execute", kind="hang", times=2, after_calls=1)
+    try:
+        hung = _run(tmp_path, "hung", _config(depth=3), inp, n)
+        fired = FAULTS.fired("device.execute")
+    finally:
+        FAULTS.reset()
+        WATCHDOG.reset()
+
+    assert _table_key(clean[0]) == _table_key(hung[0])
+    assert _table_key(clean[1]) == _table_key(hung[1])
+    assert _table_key(clean[2]) == _table_key(hung[2]) == {}
+    assert (clean[3].success, clean[3].filtered, clean[3].errors) == (
+        hung[3].success, hung[3].filtered, hung[3].errors,
+    )
+    assert fired == 2  # both armed hangs triggered (and were rescued)
+    assert METRICS.get("watchdog_stalls_total") >= stalls_before + 2
+    assert METRICS.get("watchdog_escalations_total") >= esc_before + 1
+
+
+def test_disabled_watchdog_is_inert_at_every_seam(tmp_path):
+    """The zero-cost claim: with the default deadline 0 every seam takes
+    the one-attribute-check fast path and never constructs a beat or a
+    bounded wait.  Replace every watchdog entry point with a tripwire and
+    run the full overlapped pipeline — any touch fails the run.
+    (join_thread/deadline_for are exempt: writer teardown is bounded
+    unconditionally, by design.)"""
+    assert WATCHDOG.enabled is False
+
+    def boom(*a, **k):
+        raise AssertionError("disabled watchdog was consulted on hot path")
+
+    inp = str(tmp_path / "in.parquet")
+    n = 150
+    _write_corpus(inp, n)
+    originals = {}
+    try:
+        for name in (
+            "stage_beat", "wait", "wait_device_ready", "queue_get",
+            "queue_put", "check_beat", "stall",
+        ):
+            originals[name] = getattr(WATCHDOG, name)
+            setattr(WATCHDOG, name, boom)
+        kept, excl, errs, result = _run(tmp_path, "inert", _config(depth=3), inp, n)
+    finally:
+        for name, fn in originals.items():
+            setattr(WATCHDOG, name, fn)
+    assert result.received == n and result.errors == 0
+    assert _table_key(errs) == {}
+
+
+# --- scheduling-only knob guards ---------------------------------------------
+
+
+def test_deadline_knob_not_in_compile_cache_keys():
+    """Scheduling-only: the stage deadline re-times host-side waits but
+    never changes a compiled program, so it must stay out of the AOT cache
+    key while the profiler's drift note still names it."""
+    from textblaster_tpu.utils import compile_cache, profiler
+
+    assert ENV_KNOB not in compile_cache._TRACE_ENV_KNOBS
+    assert ENV_KNOB in profiler._SCHEDULING_ENV_KNOBS
+
+
+def test_env_drift_note_names_deadline_knob(monkeypatch):
+    from textblaster_tpu.utils.profiler import _env_drift_note
+
+    monkeypatch.setenv(ENV_KNOB, "30")
+    notes = _env_drift_note({"env": {}})
+    assert any(ENV_KNOB in n for n in notes)
+    monkeypatch.delenv(ENV_KNOB)
+    assert not any(ENV_KNOB in n for n in _env_drift_note({"env": {}}))
+
+
+def _clean_env(**extra):
+    env = {
+        k: v for k, v in os.environ.items() if not k.startswith("TEXTBLAST_")
+    }
+    env["TEXTBLAST_PALLAS_INTERPRET"] = "1"
+    env.update(extra)
+    return env
+
+
+@pytest.mark.profile
+def test_sentinel_counts_check_passes_with_watchdog_enabled(tmp_path):
+    """An armed watchdog bounds waits but must never change a compiled
+    program or its dispatch counts: the counts-only sentinel check against
+    the checked-in baseline must stay PASS with the knob set."""
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "textblaster_tpu.utils.profiler",
+            "--check",
+            str(REPO / "profiles" / "sentinel_baseline.json"),
+            "--counts-only",
+        ],
+        env=_clean_env(
+            TEXTBLAST_STAGE_DEADLINE_S="30",
+            TEXTBLAST_AOT_CACHE_DIR=str(tmp_path / "aot"),
+        ),
+        cwd=str(REPO),
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PASS" in proc.stdout
+
+
+# --- 2-process coordinated runs (slow) ---------------------------------------
+
+YAML_2P = """
+pipeline:
+  - type: LanguageDetectionFilter
+    min_confidence: 0.5
+    allowed_languages: [ "dan", "eng" ]
+  - type: GopherQualityFilter
+    min_doc_words: 4
+    min_stop_words: 1
+    stop_words: [ "og", "the", "er", "i" ]
+"""
+
+
+def _docs(n=96):
+    base = [
+        "Det er en god dag i dag, og vi skal ud at gå en lang tur i skoven nu.",
+        "The quick brown fox jumps over the lazy dog and the old stone bridge.",
+        "Samme linje her igen.\n" * 6,
+        "kort.",
+        "Endnu en dansk tekst om vejret, og den er ganske lang og fin at læse.",
+        "Vi mødes nede ved havnen i morgen, og så sejler vi ud på vandet.",
+    ]
+    return [
+        TextDocument(id=f"wd-{i}", source="s", content=base[i % len(base)])
+        for i in range(n)
+    ]
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_cli(tmp_path, docs, yaml_text, timeout=560, per_proc_args=None,
+               extra_env=None, per_proc_env=None, tag="run"):
+    """Run the 2-process coordinated CLI; ``per_proc_env[pid]`` adds
+    rank-specific env (how exactly one rank gets a fault armed)."""
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(yaml_text, encoding="utf-8")
+    inp = tmp_path / "input.parquet"
+    if not inp.exists():
+        pq.write_table(
+            pa.table(
+                {
+                    "id": [d.id for d in docs],
+                    "text": [d.content for d in docs],
+                    "source": [d.source for d in docs],
+                }
+            ),
+            inp,
+        )
+    out = tmp_path / f"{tag}-kept.parquet"
+    exc = tmp_path / f"{tag}-excluded.parquet"
+    rep = tmp_path / f"{tag}-report.json"
+    port = _free_port()
+    procs = []
+    try:
+        for pid in (0, 1):
+            env = {
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+                "PATH": "/usr/bin:/bin:/usr/local/bin",
+                "HOME": "/root",
+            }
+            env.update(extra_env or {})
+            env.update((per_proc_env or {}).get(pid, {}))
+            procs.append(
+                subprocess.Popen(
+                    [
+                        sys.executable, "-m", "textblaster_tpu.cli", "run",
+                        "--coordinator", f"localhost:{port}",
+                        "--num-processes", "2",
+                        "--process-id", str(pid),
+                        "-i", str(inp),
+                        "-o", str(out),
+                        "-e", str(exc),
+                        "-c", str(cfg),
+                        "--buckets", "512,2048",
+                        # 48 local docs / 8 rows = 6 rounds per phase: the
+                        # hang lands with peers mid-lockstep, so recovery
+                        # must go through the joint verdict.
+                        "--device-batch", "8",
+                        "--run-report", str(rep),
+                        "--quiet",
+                        *(per_proc_args or {}).get(pid, ()),
+                    ],
+                    cwd=str(REPO),
+                    env=env,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT,
+                    text=True,
+                )
+            )
+        outputs = []
+        for p in procs:
+            o, _ = p.communicate(timeout=timeout)
+            outputs.append(o)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return procs, outputs, out, exc, rep
+
+
+def _rows(path):
+    return pq.read_table(path).to_pylist() if path.exists() else []
+
+
+def _one_rank_hang_run(tmp_path, transport_args, deadline_via_env):
+    """Fault-free vs one-rank device hang through the real 2-process CLI:
+    returns (clean rows, faulted rows, merged report dict)."""
+    docs = _docs(96)
+    depth = ("--pipeline-depth", "3")
+    procs, outputs, c_out, c_exc, _ = _spawn_cli(
+        tmp_path, docs, YAML_2P, tag="clean",
+        per_proc_args={0: depth + transport_args, 1: depth + transport_args},
+    )
+    for p, o in zip(procs, outputs):
+        assert p.returncode == 0, o[-2000:]
+    # Arm the hang on rank 0 only; the stage deadline is armed on both
+    # ranks (env on one variant, the CLI flag on the other) so the hang is
+    # rescued on its own thread and escalates through the joint verdict.
+    deadline_args = () if deadline_via_env else ("--stage-deadline-s", "2.5")
+    extra_env = {
+        "TEXTBLAST_FAULTS": "device.execute:hang:after=2",
+        "TEXTBLAST_FAULTS_PROCESS": "0",
+    }
+    if deadline_via_env:
+        extra_env["TEXTBLAST_STAGE_DEADLINE_S"] = "2.5"
+    procs, outputs, f_out, f_exc, rep = _spawn_cli(
+        tmp_path, docs, YAML_2P, tag="hung",
+        per_proc_args={
+            0: depth + transport_args + deadline_args,
+            1: depth + transport_args + deadline_args,
+        },
+        extra_env=extra_env,
+    )
+    for p, o in zip(procs, outputs):
+        assert p.returncode == 0, o[-2000:]
+    assert _rows(f_out) == _rows(c_out)  # ordered row-for-row identity
+    assert _rows(f_exc) == _rows(c_exc)
+    return json.loads(rep.read_text(encoding="utf-8"))["resilience"]
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_two_process_one_rank_device_hang_kv(tmp_path: Path):
+    """One rank's device dispatch wedged mid-run on the KV exchange path
+    (deadline armed via TEXTBLAST_STAGE_DEADLINE_S): byte-identical to
+    fault-free, with the stall and its escalation in the merged report."""
+    res = _one_rank_hang_run(tmp_path, (), deadline_via_env=True)
+    assert res["watchdog_stalls_total"] >= 1
+    assert res["watchdog_escalations_total"] >= 1
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_two_process_one_rank_device_hang_file_transport(tmp_path: Path):
+    """Same wedge through the file-lease transport, deadline armed via the
+    --stage-deadline-s CLI flag instead of the env knob.  The lease TTL is
+    pinned high: this test pins stall recovery, and a loaded CI box must
+    not starve the 10s default into an unrelated eviction."""
+    res = _one_rank_hang_run(
+        tmp_path,
+        ("--exchange-transport", "file", "--lease-ttl-s", "60"),
+        deadline_via_env=False,
+    )
+    assert res["watchdog_stalls_total"] >= 1
+    assert res["watchdog_escalations_total"] >= 1

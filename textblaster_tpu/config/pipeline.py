@@ -321,6 +321,13 @@ class ResilienceConfig:
     breaker_cooldown_s: float = 30.0  # open time before a half-open probe;
     #                                   0 latches open for the run's life
     split_retry: bool = True      # enable the split-in-half OOM rung
+    # Stall-watchdog deadline per host-side stage (device fetch, pack wait,
+    # write queue, reader prefetch), in seconds.  0 (the default) disarms
+    # the watchdog entirely — every seam keeps its historical unbounded
+    # wait and pays one attribute check.  Scheduling-only like the rest of
+    # this mapping: a stall degrades *where* work runs, never what it
+    # decides, so it stays out of the checkpoint fingerprint and AOT keys.
+    stage_deadline_s: float = 0.0
 
     def validate(self) -> None:
         if self.max_retries < 0:
@@ -350,6 +357,11 @@ class ResilienceConfig:
             raise ConfigValidationError(
                 "ResilienceConfig: breaker_cooldown_s must be non-negative, "
                 f"got {self.breaker_cooldown_s}"
+            )
+        if self.stage_deadline_s < 0.0:
+            raise ConfigValidationError(
+                "ResilienceConfig: stage_deadline_s must be non-negative, "
+                f"got {self.stage_deadline_s}"
             )
 
     @classmethod

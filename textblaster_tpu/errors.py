@@ -27,6 +27,7 @@ __all__ = [
     "UnexpectedError",
     "CheckpointError",
     "RetryExhaustedError",
+    "StallError",
     "PeerFailure",
     "GangReformed",
     "ReformationFailed",
@@ -220,4 +221,42 @@ class RetryExhaustedError(PipelineError):
         return (
             f"Retries exhausted at seam '{self.seam}' after {self.attempts} "
             f"attempt(s); last error: {self.last}"
+        )
+
+
+class StallError(PipelineError):
+    """A host-side stage exceeded its watchdog deadline without making
+    progress (no reference equivalent — the reference's broker consumers
+    rely on AMQP heartbeats).
+
+    Raised by the stall watchdog instead of blocking forever when a
+    deadline-bounded wait (device fetch, pack-pool future, write-behind
+    queue, reader prefetch) stops progressing.  Carries the stage name,
+    how long the wait had been pending, and the deadline that expired, so
+    operators see *where* the pipeline wedged rather than a silent hang.
+    Classified retryable: a device-fetch stall descends the ordinary
+    retry → split-half → host-oracle ladder, and on the lockstep path
+    converts to a local fault verdict so the gang drains the window
+    jointly instead of riding the exchange deadline to gang death.
+    """
+
+    def __init__(
+        self,
+        stage: str,
+        *,
+        elapsed_s: float,
+        deadline_s: float,
+        detail: str = "",
+    ) -> None:
+        super().__init__(stage, elapsed_s, deadline_s, detail)
+        self.stage = stage
+        self.elapsed_s = elapsed_s
+        self.deadline_s = deadline_s
+        self.detail = detail
+
+    def __str__(self) -> str:
+        extra = f" ({self.detail})" if self.detail else ""
+        return (
+            f"Stage '{self.stage}' stalled: no progress after "
+            f"{self.elapsed_s:.1f}s (deadline {self.deadline_s:.1f}s){extra}"
         )

@@ -173,10 +173,15 @@ def _fold_partners() -> Tuple[Dict[int, Tuple[int, ...]], frozenset]:
         lcp = _table_lower(cp)
         if lcp != cp and lcp < max_cp:
             has_preimage[lcp] = True
+    # Greek final sigma ς (U+03C2) has no uppercase pre-image (Σ lowers to
+    # σ), but it ends nearly every Greek word — hazard-flagging it would
+    # silently host-re-decide almost every Greek row, which is worse than
+    # honestly disqualifying the (σ-containing) list to the whole-list host
+    # fallback.  Treat it as common despite the pre-image test.
     common = frozenset(
         x
         for x in {p for v in partners.values() for p in v} | set(partners)
-        if x < 0x80 or has_preimage[x]
+        if x < 0x80 or has_preimage[x] or x == 0x3C2
     )
     return (
         {k: tuple(sorted(v)) for k, v in partners.items()},

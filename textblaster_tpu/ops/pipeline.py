@@ -52,6 +52,7 @@ from ..pipeline_builder import build_pipeline_from_config
 from ..resilience.breaker import CircuitBreaker
 from ..resilience.faults import FAULTS
 from ..resilience.retry import RetryPolicy
+from ..resilience.watchdog import WATCHDOG
 from ..utils.metrics import FILTER_DROP_PREFIX, METRICS
 from ..utils.profiler import PROFILER
 from ..utils.telemetry import TELEMETRY
@@ -1537,7 +1538,14 @@ class CompiledPipeline:
         stats WITHOUT blocking (JAX async dispatch) — the caller overlaps the
         previous batch's host-side assembly with this batch's device compute
         (the double-buffered feed SURVEY.md §2.5 maps prefetch/QoS onto)."""
-        FAULTS.fire("device.execute")
+        if WATCHDOG.enabled:
+            # Beat scope lets an injected device hang (chaos kind "hang")
+            # be rescued by the stage deadline on this thread; disabled,
+            # the seam pays exactly this one attribute check.
+            with WATCHDOG.stage_beat("device_fetch"):
+                FAULTS.fire("device.execute")
+        else:
+            FAULTS.fire("device.execute")
         record_occupancy(batch)
         if TELEMETRY.enabled:
             TELEMETRY.mark("dispatch", (d.id for d in batch.docs))
@@ -1581,8 +1589,19 @@ class CompiledPipeline:
         the caller records it once per round so negotiated re-dispatches don't
         skew the telemetry.  ``batch`` is any pre-packed ``PackedBatch`` —
         the lockstep window packs rounds ahead on the shared pack pool and
-        hands the resolved batches here, so this seam must stay pack-free."""
-        FAULTS.fire("multihost.round")
+        hands the resolved batches here, so this seam must stay pack-free.
+
+        Fires ``"device.execute"`` too (the same device-dispatch seam as
+        :meth:`dispatch_batch`), so hang chaos armed on the device seam
+        lands on the lockstep path as well and escalates through the
+        negotiated local-fault verdict."""
+        if WATCHDOG.enabled:
+            with WATCHDOG.stage_beat("device_fetch"):
+                FAULTS.fire("device.execute")
+                FAULTS.fire("multihost.round")
+        else:
+            FAULTS.fire("device.execute")
+            FAULTS.fire("multihost.round")
         if TELEMETRY.enabled:
             TELEMETRY.mark("dispatch", (d.id for d in batch.docs))
         with TRACER.span(
@@ -1622,6 +1641,14 @@ class CompiledPipeline:
                 stats = self.dispatch_batch(batch, phase)
             if TELEMETRY.enabled:
                 TELEMETRY.mark("device_wait", (d.id for d in batch.docs))
+            if WATCHDOG.enabled:
+                # Deadline-bounded readiness poll so the blocking
+                # device_get below cannot wedge this rank; a StallError
+                # here enters the same retry → ladder path as a raised
+                # device fault.
+                WATCHDOG.wait_device_ready(
+                    "device_fetch", jax.tree_util.tree_leaves(stats)
+                )
             t0 = time.perf_counter()
             try:
                 with TRACER.span(
@@ -1972,11 +1999,16 @@ class CompiledPipeline:
         try:
             stats = self.dispatch_batch(batch, phase)
             if no_overlap:
+                if WATCHDOG.enabled:
+                    WATCHDOG.wait_device_ready(
+                        "device_fetch", jax.tree_util.tree_leaves(stats)
+                    )
                 jax.block_until_ready(stats)
             return stats
         except Exception as e:  # noqa: BLE001
             if self._retry.classify(e) != "retryable":
                 raise
+            WATCHDOG.escalated(e)
             # Failed launch: hand the batch to the ladder with nothing in
             # flight (its first retry attempt re-dispatches).
             logger.warning("Device dispatch failed (phase %d): %s", phase, e)
@@ -2009,14 +2041,22 @@ class CompiledPipeline:
 
             route_dict = self._route_dict_scripts
             route_astral = self.wire_u16
+            # Routing decisions recorded at route_fn time (the packer calls
+            # route_fn once per non-over-length doc), so the fallback
+            # classification below reuses them instead of re-running the
+            # has_dict_script/has_astral scans on every fallback doc.
+            routed: Dict[int, bool] = {}
 
             def _host_routed(doc: TextDocument) -> bool:
-                return (route_dict and has_dict_script(doc.content)) or (
+                decision = (route_dict and has_dict_script(doc.content)) or (
                     route_astral and has_astral(doc.content)
                 )
+                routed[id(doc)] = decision
+                return decision
 
         else:
             _host_routed = None
+            routed = {}
         for phase in range(len(self.phases)):
             t0 = time.perf_counter()
             timing = {"dispatch": 0.0, "drain": 0.0}
@@ -2056,7 +2096,7 @@ class CompiledPipeline:
                     # routing — count them apart so the bench's honesty
                     # metric stays meaningful.
                     if len(doc.content) > over_length or (
-                        route is not None and route(doc)
+                        route is not None and routed.get(id(doc), False)
                     ):
                         METRICS.inc("worker_host_fallback_total")
                     else:
@@ -2097,7 +2137,12 @@ class CompiledPipeline:
                         # Overlapped items are pack futures; resolving here
                         # keeps FIFO order (futures complete out of order,
                         # but we only ever wait on the oldest).
-                        batch = item.result() if hasattr(item, "result") else item
+                        if hasattr(item, "result"):
+                            if WATCHDOG.enabled:
+                                WATCHDOG.wait("pack_wait", item.done)
+                            batch = item.result()
+                        else:
+                            batch = item
                         if overlapped:
                             METRICS.set("queue_depth_pack", src.qsize())
                             TRACER.counter("queue_depth_pack", src.qsize())

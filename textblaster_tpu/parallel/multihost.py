@@ -92,6 +92,7 @@ from ..resilience.membership import (
     _kv_set,
     elect_members,
 )
+from ..resilience.watchdog import WATCHDOG
 from ..utils.trace import TRACER
 from .mesh import DATA_AXIS, batch_sharding
 
@@ -1094,6 +1095,15 @@ def _local_stats(out: dict) -> dict:
         ]
         for k, v in out.items()
     }
+    if WATCHDOG.enabled:
+        # Deadline-bounded readiness poll before the blocking transfer: a
+        # wedged lockstep dispatch raises StallError here, which the
+        # negotiated guard converts to a local fault verdict — the gang
+        # jointly drains/retries instead of riding the exchange deadline.
+        WATCHDOG.wait_device_ready(
+            "device_fetch",
+            (s for parts in shard_tree.values() for s in parts),
+        )
     host_tree = jax.device_get(shard_tree)
     return {
         k: (np.concatenate(parts, axis=0) if parts else np.empty((0,)))
@@ -1414,6 +1424,7 @@ def run_local_shard(
         except BaseException as e:  # noqa: BLE001 — classifier decides
             if classify_error(e) != "retryable":
                 raise
+            WATCHDOG.escalated(e)
             return None, True
 
     def phase_rewrites(ph: int) -> bool:
@@ -1775,6 +1786,7 @@ def run_local_shard(
                             except BaseException as e:  # noqa: BLE001
                                 if classify_error(e) != "retryable":
                                     raise
+                                WATCHDOG.escalated(e)
                                 fault = True
                         faults.append(fault)
                         stats_list.append(st)
@@ -1873,12 +1885,12 @@ def run_local_shard(
                                 fut = prepack_next.pop(key, None)
                                 if fut is None:
                                     continue
+                                if hasattr(fut, "result"):
+                                    if WATCHDOG.enabled:
+                                        WATCHDOG.wait("pack_wait", fut.done)
+                                    fut = fut.result()
                                 e = {
-                                    "batch": (
-                                        fut.result()
-                                        if hasattr(fut, "result")
-                                        else fut
-                                    ),
+                                    "batch": fut,
                                     "out": None,
                                     "fault": False,
                                 }
@@ -1969,6 +1981,7 @@ def run_local_shard(
                                     except BaseException as e:  # noqa: BLE001
                                         if classify_error(e) != "retryable":
                                             raise
+                                        WATCHDOG.escalated(e)
                                         fault = True
                             faults.append(fault)
                             stats_list.append(st)
@@ -2215,11 +2228,12 @@ def run_local_shard(
                                 out, fault = se["out"], se["fault"]
                         else:
                             item = packs.pop(j)
-                            local = (
-                                item.result()
-                                if hasattr(item, "result")
-                                else item
-                            )
+                            if hasattr(item, "result"):
+                                if WATCHDOG.enabled:
+                                    WATCHDOG.wait("pack_wait", item.done)
+                                local = item.result()
+                            else:
+                                local = item
                             record_occupancy(local)
                             out, fault = launch(local, phase)
                     window.append({
@@ -3145,10 +3159,21 @@ def _finish_file_coordinated(
     membership_store.withdraw()
     import shutil
 
-    # The merger outlives every peer's withdraw (they returned before the
-    # merge's totals barrier released it), so removing the membership dir
-    # here cannot race a live lease — at worst a peer's stale exchange
-    # slots vanish with it, which is the point.
+    # Bounded wait for every peer's withdraw before removing the dir: a
+    # peer withdraws only AFTER its final exchange read completes, so the
+    # leases going away proves nobody is still polling the last report
+    # slots.  Removing eagerly races a peer that posted its final row but
+    # has not yet read the merger's (a ~10 ms window this merger can win
+    # under load): the peer's next liveness self-check then finds its own
+    # lease gone and dies typed on an otherwise healthy run.  The timeout
+    # covers peers that crashed mid-run and left a stale lease behind.
+    peers = [r for r in file_transport.members() if r != process_id]
+    deadline = time.monotonic() + min(membership_store.ttl_s, 10.0)
+    while peers and time.monotonic() < deadline:
+        leases = membership_store.read_leases()
+        if not any(r in leases for r in peers):
+            break
+        time.sleep(0.02)
     shutil.rmtree(membership_root, ignore_errors=True)
     return merged
 

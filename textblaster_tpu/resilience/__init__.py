@@ -26,6 +26,12 @@ gives the broker-free executor a real one:
   allgathers a fault flag and ALL hosts jointly retry (shared zero-jitter
   backoff), then jointly degrade the round to the host oracle, with
   per-bucket breakers latched by the shared verdict sequence;
+* :mod:`~textblaster_tpu.resilience.watchdog` — :data:`WATCHDOG`, the
+  stall watchdog: per-stage deadlines over the host-side blocking waits
+  (device fetch, pack futures, write-behind queue, reader prefetch) that
+  raise a typed :class:`StallError` instead of hanging forever, escalating
+  through the same retry ladder / negotiated fault verdicts as raised
+  faults;
 * :mod:`~textblaster_tpu.resilience.membership` — elastic gang membership:
   renewable liveness leases (KV store for lockstep runs, shared-filesystem
   files for ``--elastic``), membership epochs that bump when the gang
@@ -58,6 +64,7 @@ from .retry import (
     is_oom_error,
     is_retryable_error,
 )
+from .watchdog import WATCHDOG, StageWatchdog
 
 __all__ = [
     "CircuitBreaker",
@@ -73,6 +80,8 @@ __all__ = [
     "NegotiatedGuard",
     "PeerFailure",
     "RetryPolicy",
+    "StageWatchdog",
+    "WATCHDOG",
     "arm_from_env",
     "classify_error",
     "is_oom_error",

@@ -57,6 +57,7 @@ from ..utils.metrics import METRICS
 from ..utils.trace import TRACER
 from .breaker import CircuitBreaker
 from .retry import RetryPolicy, classify_error
+from .watchdog import WATCHDOG
 
 logger = logging.getLogger(__name__)
 
@@ -281,6 +282,7 @@ class NegotiatedGuard:
                     except BaseException as e:  # noqa: BLE001 — classifier decides
                         if classify_error(e) != "retryable":
                             raise
+                        WATCHDOG.escalated(e)
                         logger.warning(
                             "Lockstep round (bucket %s) faulted locally on "
                             "attempt %d: %s",

@@ -25,10 +25,12 @@ from ..errors import (
     DocumentFiltered,
     PipelineError,
     RetryExhaustedError,
+    StallError,
     StepError,
 )
 from ..utils.metrics import METRICS
 from ..utils.trace import TRACER
+from .watchdog import WATCHDOG
 
 logger = logging.getLogger(__name__)
 
@@ -103,6 +105,12 @@ def classify_error(exc: BaseException) -> str:
     (deterministic — do not re-attempt)."""
     if isinstance(exc, _DETERMINISTIC_TYPES):
         return "fatal"
+    if isinstance(exc, StallError):
+        # Watchdog stall: the stalled stage may complete on a re-attempt
+        # (re-dispatch, fresh fetch), and the degradation ladder bounds the
+        # damage if it never does — explicitly retryable so a hang enters
+        # the same recovery machinery as a raised transient fault.
+        return "retryable"
     if isinstance(exc, (KeyboardInterrupt, SystemExit, GeneratorExit)):
         return "fatal"
     if isinstance(exc, (OSError, TimeoutError, ConnectionError, MemoryError)):
@@ -181,6 +189,7 @@ class RetryPolicy:
             except BaseException as e:  # noqa: BLE001 — classifier decides
                 if self.classify(e) != "retryable":
                     raise
+                WATCHDOG.escalated(e)
                 if attempt >= self.max_retries:
                     METRICS.inc("resilience_retry_exhausted_total")
                     raise RetryExhaustedError(seam, attempt + 1, e) from e

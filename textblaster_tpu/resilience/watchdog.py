@@ -1,0 +1,308 @@
+"""Stall watchdog: per-stage deadlines over the host-side blocking waits.
+
+The rest of the resilience stack handles failures that *raise*; this module
+handles failures that *hang*.  The overlapped host pipeline has four
+blocking seams where a wedged dependency stalls a rank silently — the
+device fetch (``jax.device_get`` / ``block_until_ready`` on an XLA dispatch
+that never completes), pack-pool futures, the write-behind queue, and the
+reader prefetch queue.  Without supervision the rest of a lockstep gang
+only discovers such a stall through the blunt cross-host exchange deadline,
+which kills the run instead of recovering it.
+
+:class:`StageWatchdog` deadline-bounds each stage.  Every bounded wait is a
+*polling* loop with a short tick, so the wait stays interruptible: when the
+stage deadline expires the watchdog raises a typed
+:class:`~textblaster_tpu.errors.StallError` naming the stage, the elapsed
+time, and the deadline.  ``StallError`` is classified retryable, so a
+device-fetch stall enters the ordinary retry → split-half → host-oracle
+degradation ladder exactly like a raised fault, and on the lockstep path it
+converts to a local fault verdict so the gang jointly drains the window.
+
+Inert by default: every production seam guards its watchdog branch with a
+single ``if WATCHDOG.enabled:`` attribute check and keeps the original
+unbounded wait in the ``else`` arm — a disabled watchdog (the default;
+``--stage-deadline-s 0``) adds exactly one attribute read per seam and
+never constructs a beat, timestamp, or closure.
+
+The deadline knob is *scheduling-only*: it cannot change any document
+decision or output byte, so it is excluded from AOT compile-cache keys and
+only named in the profiler's env-drift notes (like ``TEXTBLAST_SPECULATE``).
+"""
+
+from __future__ import annotations
+
+import queue as queue_mod
+import threading
+import time
+from contextlib import contextmanager
+from typing import Callable, Dict, Iterable, Iterator, Mapping, Optional
+
+from ..errors import StallError
+from ..utils.metrics import METRICS
+from ..utils.trace import TRACER
+
+__all__ = ["ENV_KNOB", "STAGES", "StageWatchdog", "WATCHDOG"]
+
+#: The four supervised host-side stages, in pipeline order.
+STAGES = ("device_fetch", "pack_wait", "write_queue", "read_prefetch")
+
+#: Environment knob: default per-stage deadline in seconds (0 disables).
+ENV_KNOB = "TEXTBLAST_STAGE_DEADLINE_S"
+
+#: Poll interval for bounded waits.  Short enough that an expired deadline
+#: surfaces promptly; long enough that the enabled-path overhead stays in
+#: the noise next to real device/queue latencies.
+_TICK_S = 0.02
+
+
+class _Beat:
+    """A thread-local heartbeat: 'this thread is inside *stage* since
+    *start*'.  The fault injector's latency kinds (``delay=``/``hang``)
+    consult the current beat so an injected hang can be rescued by the
+    stage deadline on the hanging thread itself — no monitor thread."""
+
+    __slots__ = ("stage", "start", "deadline_s")
+
+    def __init__(self, stage: str, start: float, deadline_s: float) -> None:
+        self.stage = stage
+        self.start = start
+        self.deadline_s = deadline_s
+
+
+class StageWatchdog:
+    """Deadline supervisor for the host-side pipeline stages.
+
+    One process-global instance (:data:`WATCHDOG`) is shared by every seam;
+    ``configure()`` arms it (CLI ``--stage-deadline-s`` or the
+    ``TEXTBLAST_STAGE_DEADLINE_S`` env knob), ``reset()`` disarms for tests.
+    """
+
+    def __init__(self) -> None:
+        self.enabled = False
+        self._default_s = 0.0
+        self._per_stage: Dict[str, float] = {}
+        self._tls = threading.local()
+
+    # -- configuration -----------------------------------------------------
+
+    def configure(
+        self,
+        deadline_s: float,
+        per_stage: Optional[Mapping[str, float]] = None,
+    ) -> None:
+        """Arm (deadline > 0) or disarm (deadline <= 0) the watchdog.
+
+        ``per_stage`` overrides the default deadline for individual stages.
+        Publishes one ``watchdog_deadline_seconds_<stage>`` gauge per stage
+        when armed so the run report records the active deadlines.
+        """
+        self._default_s = max(0.0, float(deadline_s))
+        self._per_stage = {
+            str(k): max(0.0, float(v)) for k, v in (per_stage or {}).items()
+        }
+        self.enabled = self._default_s > 0 or any(
+            v > 0 for v in self._per_stage.values()
+        )
+        if self.enabled:
+            for stage in STAGES:
+                METRICS.set(
+                    "watchdog_deadline_seconds_" + stage,
+                    self.deadline_for(stage),
+                )
+
+    def configure_from_env(self, env: Optional[Mapping[str, str]] = None) -> None:
+        """Arm from ``TEXTBLAST_STAGE_DEADLINE_S`` (unset/invalid → leave
+        the current configuration alone)."""
+        import os
+
+        raw = (env if env is not None else os.environ).get(ENV_KNOB)
+        if raw is None or not str(raw).strip():
+            return
+        try:
+            self.configure(float(raw))
+        except (TypeError, ValueError):
+            return
+
+    def reset(self) -> None:
+        """Disarm and forget per-stage overrides (test hygiene)."""
+        self.enabled = False
+        self._default_s = 0.0
+        self._per_stage = {}
+        self._tls = threading.local()
+
+    def deadline_for(self, stage: str) -> float:
+        """Effective deadline for *stage* in seconds (0 = unbounded)."""
+        return self._per_stage.get(stage, self._default_s)
+
+    # -- stall bookkeeping -------------------------------------------------
+
+    def stall(
+        self, stage: str, elapsed_s: float, deadline_s: float, detail: str = ""
+    ) -> None:
+        """Record a stall and raise the typed :class:`StallError`."""
+        METRICS.inc("watchdog_stalls_total")
+        TRACER.instant(
+            "watchdog_stall",
+            {
+                "stage": stage,
+                "elapsed_s": round(elapsed_s, 3),
+                "deadline_s": deadline_s,
+                "detail": detail,
+            },
+        )
+        raise StallError(
+            stage, elapsed_s=elapsed_s, deadline_s=deadline_s, detail=detail
+        )
+
+    def escalated(self, exc: BaseException) -> None:
+        """Count a stall handed to existing recovery machinery (retry
+        ladder, negotiated fault verdict).  No-op for non-stall errors so
+        callers can report every retryable exception unconditionally."""
+        if isinstance(exc, StallError):
+            METRICS.inc("watchdog_escalations_total")
+            TRACER.instant("watchdog_escalation", {"stage": exc.stage})
+
+    # -- heartbeats (fault-injector integration) ---------------------------
+
+    @contextmanager
+    def stage_beat(self, stage: str) -> Iterator[None]:
+        """Mark this thread as inside *stage* for the dynamic extent.
+
+        The fault injector's ``delay``/``hang`` kinds poll the current beat
+        so an injected hang raises ``StallError`` on its own thread when
+        the stage deadline expires.
+        """
+        prev = getattr(self._tls, "beat", None)
+        self._tls.beat = _Beat(stage, time.monotonic(), self.deadline_for(stage))
+        try:
+            yield
+        finally:
+            self._tls.beat = prev
+
+    def current_beat(self) -> Optional[_Beat]:
+        return getattr(self._tls, "beat", None)
+
+    def check_beat(self, detail: str = "") -> None:
+        """Raise ``StallError`` iff this thread's beat deadline expired."""
+        beat = self.current_beat()
+        if beat is None or beat.deadline_s <= 0:
+            return
+        elapsed = time.monotonic() - beat.start
+        if elapsed >= beat.deadline_s:
+            self.stall(beat.stage, elapsed, beat.deadline_s, detail)
+
+    # -- bounded waits -----------------------------------------------------
+
+    def wait(
+        self,
+        stage: str,
+        done: Callable[[], bool],
+        detail: Optional[Callable[[], str]] = None,
+    ) -> None:
+        """Poll ``done()`` until true; raise ``StallError`` at the stage
+        deadline.  With an unbounded stage (deadline 0) returns at once so
+        the caller falls through to its ordinary blocking wait."""
+        deadline_s = self.deadline_for(stage)
+        if deadline_s <= 0:
+            return
+        start = time.monotonic()
+        while not done():
+            elapsed = time.monotonic() - start
+            if elapsed >= deadline_s:
+                self.stall(
+                    stage, elapsed, deadline_s, detail() if detail else ""
+                )
+            time.sleep(_TICK_S)
+
+    def wait_device_ready(self, stage: str, leaves: Iterable[object]) -> None:
+        """Bounded readiness wait over device arrays (duck-typed via
+        ``jax.Array.is_ready``) so the subsequent ``device_get`` /
+        ``block_until_ready`` cannot block past the stage deadline.  Leaves
+        without ``is_ready`` (host numpy, scalars) are already 'ready'."""
+        pending = [a for a in leaves if hasattr(a, "is_ready")]
+        if not pending:
+            return
+        self.wait(
+            stage,
+            lambda: all(a.is_ready() for a in pending),
+            lambda: f"{len(pending)} device array(s) in flight",
+        )
+
+    def queue_get(self, stage: str, q: "queue_mod.Queue") -> object:
+        """``q.get()`` bounded by the stage deadline."""
+        deadline_s = self.deadline_for(stage)
+        if deadline_s <= 0:
+            return q.get()
+        start = time.monotonic()
+        while True:
+            try:
+                return q.get(timeout=min(0.1, deadline_s))
+            except queue_mod.Empty:
+                elapsed = time.monotonic() - start
+                if elapsed >= deadline_s:
+                    self.stall(
+                        stage,
+                        elapsed,
+                        deadline_s,
+                        f"queue depth {q.qsize()}",
+                    )
+
+    def queue_put(self, stage: str, q: "queue_mod.Queue", item: object) -> None:
+        """``q.put(item)`` bounded by the stage deadline."""
+        deadline_s = self.deadline_for(stage)
+        if deadline_s <= 0:
+            q.put(item)
+            return
+        start = time.monotonic()
+        while True:
+            try:
+                q.put(item, timeout=min(0.1, deadline_s))
+                return
+            except queue_mod.Full:
+                elapsed = time.monotonic() - start
+                if elapsed >= deadline_s:
+                    self.stall(
+                        stage,
+                        elapsed,
+                        deadline_s,
+                        f"queue depth {q.qsize()}",
+                    )
+
+    def join_thread(
+        self, stage: str, thread: "threading.Thread", progress: Callable[[], int]
+    ) -> None:
+        """Bounded, progress-aware ``thread.join()``.
+
+        The deadline is a *no-progress* bound: each time ``progress()``
+        moves (e.g. the write queue drains an item) the timer restarts, so
+        a slow-but-live drain is never killed while a wedged one surfaces a
+        typed ``StallError`` carrying the residual depth.  Used for the
+        writer teardown, where an unbounded join could wedge shutdown
+        forever.  Falls back to a generous static bound when the watchdog
+        is disarmed — teardown is off the hot path, so the bounded join is
+        unconditional.
+        """
+        deadline_s = self.deadline_for(stage)
+        if deadline_s <= 0:
+            deadline_s = 60.0
+        last = progress()
+        start = time.monotonic()
+        while thread.is_alive():
+            thread.join(timeout=min(0.1, deadline_s))
+            now_progress = progress()
+            if now_progress != last:
+                last = now_progress
+                start = time.monotonic()
+                continue
+            elapsed = time.monotonic() - start
+            if elapsed >= deadline_s:
+                self.stall(
+                    stage,
+                    elapsed,
+                    deadline_s,
+                    f"queue depth {now_progress}",
+                )
+
+
+#: Process-global watchdog shared by every supervised seam.
+WATCHDOG = StageWatchdog()

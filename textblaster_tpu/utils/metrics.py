@@ -442,6 +442,41 @@ _SPECS: Dict[str, Tuple[str, str]] = {
         "into one combined post (largest win on the file transport, "
         "where each post is a filesystem round-trip)",
     ),
+    # Stall watchdog (resilience/watchdog.py): per-stage deadlines over the
+    # host-side blocking waits.  --stage-deadline-s 0 (the default) disarms
+    # the watchdog and zeroes every series here.
+    "watchdog_stalls_total": (
+        "counter",
+        "Host-side stage waits that exceeded their watchdog deadline and "
+        "raised a typed StallError (stage named in the trace instant) "
+        "instead of blocking forever",
+    ),
+    "watchdog_escalations_total": (
+        "counter",
+        "StallErrors handed to existing recovery machinery: the "
+        "retry -> split -> host ladder on the single-host path, a local "
+        "fault verdict (joint window drain/retry) on the lockstep path",
+    ),
+    "watchdog_deadline_seconds_device_fetch": (
+        "gauge",
+        "Active watchdog deadline for the device-fetch stage, seconds "
+        "(0 / absent = unbounded)",
+    ),
+    "watchdog_deadline_seconds_pack_wait": (
+        "gauge",
+        "Active watchdog deadline for the pack-pool future wait, seconds "
+        "(0 / absent = unbounded)",
+    ),
+    "watchdog_deadline_seconds_write_queue": (
+        "gauge",
+        "Active watchdog deadline for the write-behind queue (enqueue and "
+        "teardown drain), seconds (0 / absent = unbounded)",
+    ),
+    "watchdog_deadline_seconds_read_prefetch": (
+        "gauge",
+        "Active watchdog deadline for the reader-prefetch queue wait, "
+        "seconds (0 / absent = unbounded)",
+    ),
     # Overlapped-pipeline stage accounting (no reference equivalent).  The
     # counters are wall seconds spent *inside* each stage, summed across
     # worker threads; with overlap on, stages run concurrently, so the sum
@@ -785,7 +820,9 @@ def metrics_snapshot() -> Dict[str, float]:
 
 
 #: Counter families surfaced in the run report's resilience section.
-_RESILIENCE_REPORT_PREFIXES = ("resilience_", "deadletter_", "multihost_")
+_RESILIENCE_REPORT_PREFIXES = (
+    "resilience_", "deadletter_", "multihost_", "watchdog_",
+)
 
 
 def resilience_report(

@@ -22,7 +22,13 @@ Three primitives live here:
     single worker thread performs the actual writes in FIFO order.  The
     first write error is re-raised to the caller at the next call (or at
     ``close()``), preserving the serial path's error semantics; ``close()``
-    drains the queue, joins the thread, and closes the inner writer.
+    drains the queue, joins the thread (progress-bounded — a wedged drain
+    surfaces a typed ``StallError`` carrying the residual queue depth
+    instead of hanging shutdown forever), and closes the inner writer.
+
+Both queue seams (reader prefetch ``get``, write-behind ``put``) are
+supervised by the stall watchdog when it is armed; disabled (the default)
+each seam pays a single ``WATCHDOG.enabled`` attribute check.
 
 ``shared_pack_pool``
     The process-wide pack-worker ``ThreadPoolExecutor``.  Packing releases
@@ -36,8 +42,10 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Dict, Iterable, Iterator, List, Optional
 
+from ..resilience.watchdog import WATCHDOG
 from .metrics import METRICS
 from .trace import TRACER
 
@@ -100,7 +108,10 @@ class _PrefetchIterator:
                 return item
             if self._done:
                 raise StopIteration
-            got = self._queue.get()
+            if WATCHDOG.enabled:
+                got = WATCHDOG.queue_get("read_prefetch", self._queue)
+            else:
+                got = self._queue.get()
             # Per-block (never per-item): the gauge feeds the live rollup's
             # read-queue track the same way ThreadedWriter feeds write's.
             METRICS.set("queue_depth_read", self._queue.qsize())
@@ -219,16 +230,51 @@ class ThreadedWriter:
         if self._closed:
             raise RuntimeError("ThreadedWriter is closed")
         self._raise_pending()
-        self._queue.put(list(outcomes))
+        if WATCHDOG.enabled:
+            WATCHDOG.queue_put("write_queue", self._queue, list(outcomes))
+        else:
+            self._queue.put(list(outcomes))
         METRICS.set("queue_depth_write", self._queue.qsize())
         TRACER.counter("queue_depth_write", self._queue.qsize())
+
+    def _put_done(self) -> None:
+        # Teardown put, progress-bounded: the sentinel only fails to land
+        # if the queue is full AND the drain thread stopped consuming —
+        # surface that as a typed stall (with the residual depth) instead
+        # of blocking close() forever.  The timer restarts whenever the
+        # drain makes progress, so a slow-but-live flush is never killed.
+        deadline_s = WATCHDOG.deadline_for("write_queue") or 60.0
+        last = self._queue.qsize()
+        start = time.monotonic()
+        while True:
+            try:
+                self._queue.put(_DONE, timeout=0.1)
+                return
+            except queue.Full:
+                depth = self._queue.qsize()
+                if depth < last:
+                    last = depth
+                    start = time.monotonic()
+                    continue
+                elapsed = time.monotonic() - start
+                if elapsed >= deadline_s:
+                    WATCHDOG.stall(
+                        "write_queue",
+                        elapsed,
+                        deadline_s,
+                        f"teardown enqueue: queue depth {depth}",
+                    )
 
     def close(self) -> None:
         if self._closed:
             return
         self._closed = True
-        self._queue.put(_DONE)
-        self._thread.join()
+        self._put_done()
+        # Progress-bounded join (historically unbounded — a wedged writer
+        # thread hung shutdown forever): no-progress past the write_queue
+        # deadline (60 s when the watchdog is disarmed) raises StallError
+        # naming the stage and the residual queue depth.
+        WATCHDOG.join_thread("write_queue", self._thread, self._queue.qsize)
         try:
             if self._error is not None:
                 err, self._error = self._error, None

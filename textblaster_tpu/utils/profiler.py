@@ -632,10 +632,16 @@ def compare_profiles(
 
 #: Scheduling-only knobs the drift note also names: they must NEVER change
 #: per-(bucket, phase) dispatch counts (TEXTBLAST_SPECULATE moves multi-host
-#: launches across phase barriers, not programs), so they are deliberately
-#: NOT in compile_cache._TRACE_ENV_KNOBS — but if counts ever drift with one
-#: set, the note points straight at it instead of leaving a silent diff.
-_SCHEDULING_ENV_KNOBS = ("TEXTBLAST_SPECULATE", "TEXTBLAST_NO_OVERLAP")
+#: launches across phase barriers, not programs, and
+#: TEXTBLAST_STAGE_DEADLINE_S only bounds host-side waits), so they are
+#: deliberately NOT in compile_cache._TRACE_ENV_KNOBS — but if counts ever
+#: drift with one set, the note points straight at it instead of leaving a
+#: silent diff.
+_SCHEDULING_ENV_KNOBS = (
+    "TEXTBLAST_SPECULATE",
+    "TEXTBLAST_NO_OVERLAP",
+    "TEXTBLAST_STAGE_DEADLINE_S",
+)
 
 
 def _env_drift_note(base: Dict[str, object]) -> List[str]:
@@ -706,6 +712,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         # Deterministic CPU path; setdefault so a deliberate hatch flip
         # (e.g. TEXTBLAST_DEPFUSE=off) stays visible to the check.
         os.environ.setdefault("TEXTBLAST_PALLAS_INTERPRET", "1")
+
+    # Honor the watchdog env knob so the guard test "sentinel stays PASS
+    # with the watchdog armed" exercises the sentinel workload under the
+    # same runtime configuration a supervised run would use (the knob is
+    # scheduling-only: dispatch counts must not move).
+    from ..resilience.watchdog import WATCHDOG
+
+    WATCHDOG.configure_from_env()
 
     config = None
     if args.config:
