@@ -1172,6 +1172,61 @@ def main() -> int:
             watchdog_report = {"error": str(e)}
             _log(f"watchdog A/B skipped: {e}")
 
+    # --- Event-journal + SLO on/off A/B (BENCH_EVENTS=0 skips).  The armed
+    # arm writes a real JSONL journal (the full spill path, not just the
+    # ring) and runs the SLO engine with two objectives; the disarmed arm is
+    # the default one-attribute-check path.  Decisions must be byte-identical
+    # — observability never touches outcomes — and the combined overhead has
+    # a 2% docs/s budget.
+    events_report = None
+    if os.environ.get("BENCH_EVENTS", "1") != "0":
+        import tempfile as _ev_tempfile
+
+        from textblaster_tpu.utils.events import EVENTS
+        from textblaster_tpu.utils.slo import SLO
+
+        try:
+            ev_off_rate, ev_off_out = _kernel_pass(pipeline)
+            emitted_before = METRICS.get("events_emitted_total")
+            with _ev_tempfile.TemporaryDirectory() as ev_dir:
+                EVENTS.configure(os.path.join(ev_dir, "bench-events.jsonl"))
+                SLO.configure(
+                    {"availability": 0.999, "throughput_floor": 0.001},
+                    tick_s=0.5,
+                )
+                try:
+                    ev_on_rate, ev_on_out = _kernel_pass(pipeline)
+                finally:
+                    SLO.reset()
+                    EVENTS.close()
+            ev_on_by_id = {o.document.id: o.kind for o in ev_on_out}
+            ev_off_by_id = {o.document.id: o.kind for o in ev_off_out}
+            ev_parity = sum(
+                1 for k, v in ev_off_by_id.items() if ev_on_by_id.get(k) == v
+            ) / max(len(ev_off_by_id), 1)
+            ev_overhead = 1.0 - ev_on_rate / ev_off_rate
+            events_report = {
+                "on_docs_per_sec": round(ev_on_rate, 2),
+                "off_docs_per_sec": round(ev_off_rate, 2),
+                "overhead_frac": round(ev_overhead, 4),
+                "overhead_budget_frac": 0.02,
+                "within_budget": bool(ev_overhead <= 0.02),
+                "parity": round(ev_parity, 6),
+                "events_emitted": int(
+                    METRICS.get("events_emitted_total") - emitted_before
+                ),
+            }
+            _log(
+                f"events+SLO A/B: {ev_on_rate:.1f} docs/s armed vs "
+                f"{ev_off_rate:.1f} disarmed "
+                f"(overhead {events_report['overhead_frac']:+.2%} vs 2% "
+                f"budget, parity {ev_parity:.4f}, "
+                f"{events_report['events_emitted']} events)"
+            )
+        except Exception as e:  # never bill an events A/B problem to the bench
+            events_report = {"error": str(e)}
+            _log(f"events A/B skipped: {e}")
+
     # --- Multi-host overlap A/B (BENCH_MULTIHOST_OVERLAP=0 skips).  Real
     # 2-process coordinated CLI runs on the local box: overlapped lockstep
     # window (--pipeline-depth 3) vs serial (--no-overlap --pipeline-depth 1),
@@ -1882,6 +1937,10 @@ pipeline:
         # stalls): parity must be 1.0 and the armed overhead within noise —
         # the disarmed default pays one attribute check per seam.
         **({"watchdog": watchdog_report} if watchdog_report else {}),
+        # Event-journal + SLO-engine armed/disarmed A/B (real JSONL spill,
+        # two live objectives): parity must be 1.0 and the combined
+        # overhead within the 2% docs/s budget; off must be free.
+        **({"events": events_report} if events_report else {}),
         # Overlapped-vs-serial multi-host lockstep A/B (2 coordinated
         # processes on this box): lockstep-section docs/s both ways, the
         # negotiated window depth, window stall seconds, and decision

@@ -396,7 +396,7 @@ def test_elastic_rejects_collective_only_features(tmp_path):
 
 def test_elastic_solo_run_writes_merged_run_report(tmp_path):
     """--elastic + --run-report (formerly rejected): the merging rank must
-    emit a v3 report folding every rank's shard — trivially its own here —
+    emit a v4 report folding every rank's shard — trivially its own here —
     with exact merged counts."""
     docs = _docs(16)
     inp = _write_input(tmp_path, docs)
@@ -412,7 +412,7 @@ def test_elastic_solo_run_writes_merged_run_report(tmp_path):
         provenance={"pipeline_config": "inline"},
     )
     data = json.loads(report.read_text(encoding="utf-8"))
-    assert data["schema"] == "textblaster-run-report/v3"
+    assert data["schema"] == "textblaster-run-report/v4"
     assert data["counts"]["received"] == result.received == len(docs)
     assert data["counts"]["success"] == result.success
     assert len(data["hosts"]) == 1 and data["hosts"][0]["process"] == 0

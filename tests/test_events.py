@@ -1,0 +1,379 @@
+"""Operational event journal (utils/events.py).
+
+Covers the tentpole journal contract plus its satellites:
+
+* Monotone per-rank sequence numbers and schema-valid records for every
+  emitted kind; schema violations are counted (``events_invalid_total``)
+  and dropped, never raised.
+* Ring-overflow drop accounting (in-memory mode drops the oldest half)
+  and spill-failure drop accounting (``/dev/full``), both mirrored into
+  ``events_dropped_total`` with a one-time stderr warning — the trace
+  ring's exact contract.
+* Incremental JSONL spill: lines written before a crash are
+  self-contained and readable without ``close()`` (what survives a
+  SIGKILL is exactly the spilled prefix).
+* Determinism: the same CLI run twice produces the same event sequence
+  modulo timestamps.
+* WARNING+ log records route into the journal when armed
+  (``JournalLogHandler``), and the JSON log formatter stamps
+  ``record.created`` — not format time.
+* Inertness: a disarmed journal is one attribute check per seam and a
+  run without the flags emits nothing.
+* Schema lint: every ``EVENTS.emit`` call site in the codebase names an
+  enumerated kind and passes that kind's required data fields.
+"""
+
+import ast
+import json
+import logging
+import os
+from datetime import datetime, timezone
+from pathlib import Path
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from textblaster_tpu.cli import main
+from textblaster_tpu.utils.events import (
+    EVENTS,
+    KINDS,
+    EventJournal,
+    JournalLogHandler,
+    validate_record,
+)
+from textblaster_tpu.utils.logging_setup import _JsonFormatter
+from textblaster_tpu.utils.metrics import METRICS
+from textblaster_tpu.utils.slo import SLO
+
+pytestmark = pytest.mark.events
+
+REPO = Path(__file__).resolve().parent.parent
+
+CONFIG_YAML = """
+pipeline:
+  - type: GopherQualityFilter
+    min_doc_words: 5
+"""
+
+GOOD = (
+    "This is a sentence with a number of words that is long enough to pass "
+    "the filter easily today."
+)
+BAD = "too short"
+
+
+@pytest.fixture(autouse=True)
+def _journal_hygiene():
+    # EVENTS/SLO are process-global; a test leaving them armed would
+    # contaminate every later test in the session.
+    EVENTS.close()
+    SLO.reset()
+    yield
+    SLO.reset()
+    EVENTS.close()
+
+
+# --- core journal contract ---------------------------------------------------
+
+
+def test_seq_monotone_and_every_record_schema_valid():
+    EVENTS.configure(None, rank=3, incarnation=2)
+    EVENTS.emit("retry", seam="device", attempt=1, error="RuntimeError")
+    EVENTS.emit("breaker_trip", seam="device", failures=3)
+    EVENTS.emit("watchdog_stall", stage="pack_wait", elapsed_s=4.2,
+                deadline_s=2.0)
+    EVENTS.emit("run_end", exit_code=0)
+    records = EVENTS.drain()
+    assert [r["seq"] for r in records] == [1, 2, 3, 4]
+    for r in records:
+        validate_record(r)  # raises on any schema violation
+        assert r["rank"] == 3
+        assert r["incarnation"] == 2
+    # Timestamps ride the (monotone) trace clock.
+    ts = [r["ts_us"] for r in records]
+    assert ts == sorted(ts)
+    # Default severities come from the KINDS table.
+    assert records[0]["severity"] == "warning"
+    assert records[1]["severity"] == "error"
+    # Per-kind counts mirror into dynamic metrics counters.
+    assert EVENTS.counts() == {
+        "retry": 1, "breaker_trip": 1, "watchdog_stall": 1, "run_end": 1,
+    }
+
+
+def test_invalid_records_counted_and_dropped_never_raised():
+    invalid_before = METRICS.get("events_invalid_total")
+    emitted_before = METRICS.get("events_emitted_total")
+    EVENTS.configure(None)
+    EVENTS.emit("no_such_kind", foo=1)           # unknown kind
+    EVENTS.emit("retry", seam="device")          # missing attempt/error
+    EVENTS.emit("run_end", severity="loud", exit_code=0)  # bad severity
+    assert EVENTS.drain() == []
+    assert METRICS.get("events_invalid_total") - invalid_before == 3
+    assert METRICS.get("events_emitted_total") == emitted_before
+
+
+def test_severity_override_must_come_from_the_ladder():
+    EVENTS.configure(None)
+    EVENTS.emit("retry", severity="error", seam="s", attempt=3, error="E")
+    (rec,) = EVENTS.drain()
+    assert rec["severity"] == "error"
+    validate_record(rec)
+
+
+def test_validate_record_rejects_malformed_records():
+    EVENTS.configure(None)
+    EVENTS.emit("run_start")
+    (rec,) = EVENTS.drain()
+    validate_record(rec)
+    for mutate in (
+        lambda r: r.pop("seq"),
+        lambda r: r.update(kind="bogus"),
+        lambda r: r.update(severity="loud"),
+        lambda r: r.update(data=[1, 2]),
+    ):
+        bad = dict(rec)
+        mutate(bad)
+        with pytest.raises(ValueError):
+            validate_record(bad)
+    bad = dict(rec, kind="retry", data={})
+    with pytest.raises(ValueError, match="missing data fields"):
+        validate_record(bad)
+
+
+def test_ring_overflow_drops_oldest_half_and_counts(capsys):
+    dropped_before = METRICS.get("events_dropped_total")
+    EVENTS.configure(None, ring=16)
+    for i in range(40):
+        EVENTS.emit("checkpoint_commit", chunk=i)
+    records = EVENTS.drain()
+    assert len(records) < 40
+    # The newest event always survives; the oldest were dropped.
+    assert records[-1]["data"]["chunk"] == 39
+    dropped = METRICS.get("events_dropped_total") - dropped_before
+    assert dropped >= 8
+    assert dropped + len(records) == 40
+    assert "journal events dropped" in capsys.readouterr().err
+
+
+def test_incremental_spill_survives_without_close(tmp_path):
+    """Lines spilled while the run is alive are self-contained JSONL — a
+    SIGKILL'd rank still leaves every pre-kill spill readable."""
+    path = tmp_path / "events.jsonl"
+    j = EventJournal()
+    j.configure(str(path), rank=1, ring=16)
+    for i in range(40):
+        j.emit("checkpoint_commit", chunk=i)
+    # No close(): simulate the process dying here.  Two ring fills have
+    # already spilled 32 events.
+    lines = path.read_text(encoding="utf-8").splitlines()
+    assert len(lines) >= 32
+    seqs = []
+    for line in lines:
+        rec = json.loads(line)
+        validate_record(rec)
+        seqs.append(rec["seq"])
+    assert seqs == list(range(1, len(lines) + 1))
+    j.close()
+    full = path.read_text(encoding="utf-8").splitlines()
+    assert len(full) == 40
+
+
+@pytest.mark.skipif(not os.path.exists("/dev/full"), reason="needs /dev/full")
+def test_spill_failure_counts_drops_and_survives(capsys):
+    dropped_before = METRICS.get("events_dropped_total")
+    j = EventJournal()
+    j.configure("/dev/full", ring=16)  # open succeeds; write raises ENOSPC
+    for i in range(40):
+        j.emit("checkpoint_commit", chunk=i)
+    j.close()  # spill fails; close must survive and null the handle
+    assert j._fh is None
+    dropped = METRICS.get("events_dropped_total") - dropped_before
+    assert dropped >= 16
+    assert "journal events dropped" in capsys.readouterr().err
+
+
+def test_recent_survives_spill(tmp_path):
+    j = EventJournal()
+    j.configure(str(tmp_path / "e.jsonl"), ring=16, recent=64)
+    for i in range(40):
+        j.emit("checkpoint_commit", chunk=i)
+    recent = j.recent(10)
+    assert len(recent) == 10
+    assert [r["data"]["chunk"] for r in recent] == list(range(30, 40))
+    j.close()
+
+
+def test_disarmed_journal_is_inert():
+    assert not EVENTS.enabled
+    emitted_before = METRICS.get("events_emitted_total")
+    invalid_before = METRICS.get("events_invalid_total")
+    EVENTS.emit("retry", seam="s", attempt=1, error="E")
+    EVENTS.emit("no_such_kind")
+    assert METRICS.get("events_emitted_total") == emitted_before
+    assert METRICS.get("events_invalid_total") == invalid_before
+    assert EVENTS.drain() == []
+
+
+# --- logging bridge ----------------------------------------------------------
+
+
+def test_warning_log_records_route_into_journal():
+    log = logging.getLogger("textblaster.test.routing")
+    log.addHandler(JournalLogHandler())
+    log.propagate = False
+    try:
+        EVENTS.configure(None)
+        log.info("below the bar")
+        log.warning("resource %s is degraded", "X")
+        log.error("it broke")
+        records = EVENTS.drain()
+        assert [r["kind"] for r in records] == ["log", "log"]
+        assert records[0]["severity"] == "warning"
+        assert records[0]["data"]["message"] == "resource X is degraded"
+        assert records[0]["data"]["logger"] == "textblaster.test.routing"
+        assert records[1]["severity"] == "error"
+        for r in records:
+            validate_record(r)
+        # Disarmed: the handler is a single attribute check, no records.
+        EVENTS.close()
+        log.warning("into the void")
+        assert EVENTS.drain() == []
+    finally:
+        log.handlers.clear()
+        log.propagate = True
+
+
+def test_init_logging_installs_journal_handler():
+    from textblaster_tpu.utils.logging_setup import init_logging
+
+    init_logging("textblast-test")
+    root = logging.getLogger()
+    assert any(isinstance(h, JournalLogHandler) for h in root.handlers)
+
+
+def test_json_formatter_stamps_emit_time_not_format_time():
+    record = logging.LogRecord(
+        name="t", level=logging.WARNING, pathname=__file__, lineno=1,
+        msg="hello", args=(), exc_info=None,
+    )
+    record.created = 1_700_000_000.25  # the emit instant
+    payload = json.loads(_JsonFormatter().format(record))
+    assert payload["timestamp"] == datetime.fromtimestamp(
+        1_700_000_000.25, timezone.utc
+    ).isoformat()
+
+
+# --- CLI determinism + inertness --------------------------------------------
+
+
+def _write_corpus(dirpath: Path, n=24):
+    docs = [GOOD if i % 3 else BAD for i in range(n)]
+    pq.write_table(
+        pa.table({"id": [str(i) for i in range(n)], "text": docs}),
+        dirpath / "input.parquet",
+    )
+    (dirpath / "cfg.yaml").write_text(CONFIG_YAML, encoding="utf-8")
+
+
+def _run_cli(dirpath: Path, tag: str, extra=()):
+    rc = main([
+        "run",
+        "-i", str(dirpath / "input.parquet"),
+        "-o", str(dirpath / f"{tag}-kept.parquet"),
+        "-e", str(dirpath / f"{tag}-exc.parquet"),
+        "-c", str(dirpath / "cfg.yaml"),
+        "--backend", "host",
+        "--quiet",
+        *extra,
+    ])
+    assert rc == 0
+    return rc
+
+
+def _journal_kinds(path: Path):
+    out = []
+    for line in path.read_text(encoding="utf-8").splitlines():
+        rec = json.loads(line)
+        validate_record(rec)
+        out.append((rec["seq"], rec["kind"], rec["severity"],
+                    json.dumps(rec["data"], sort_keys=True)))
+    return out
+
+
+def test_same_run_twice_yields_same_sequence_modulo_timestamps(tmp_path):
+    _write_corpus(tmp_path)
+    for tag in ("a", "b"):
+        _run_cli(tmp_path, tag, extra=[
+            "--events-file", str(tmp_path / f"{tag}-events.jsonl"),
+        ])
+    a = _journal_kinds(tmp_path / "a-events.jsonl")
+    b = _journal_kinds(tmp_path / "b-events.jsonl")
+    assert a == b
+    assert [k for _, k, _, _ in a][0] == "run_start"
+    assert [k for _, k, _, _ in a][-1] == "run_end"
+
+
+def test_run_without_flags_emits_nothing_and_stays_disarmed(tmp_path):
+    _write_corpus(tmp_path)
+    emitted_before = METRICS.get("events_emitted_total")
+    _run_cli(tmp_path, "plain")
+    assert METRICS.get("events_emitted_total") == emitted_before
+    assert not EVENTS.enabled
+    assert not SLO.enabled
+
+
+def test_slo_only_run_arms_ring_journal_without_a_file(tmp_path):
+    _write_corpus(tmp_path)
+    emitted_before = METRICS.get("events_emitted_total")
+    _run_cli(tmp_path, "sloonly", extra=["--slo", "availability=0.5"])
+    # run_start + run_end at minimum landed in the (ring-only) journal.
+    assert METRICS.get("events_emitted_total") - emitted_before >= 2
+    assert not (tmp_path / "sloonly-events.jsonl").exists()
+
+
+# --- schema lint over every emit call site -----------------------------------
+
+
+def _emit_call_sites():
+    """Yield (file, line, kind, keyword-names, has-splat) for every
+    ``EVENTS.emit(...)`` call in the package source."""
+    pkg = REPO / "textblaster_tpu"
+    for path in sorted(pkg.rglob("*.py")):
+        tree = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not (isinstance(fn, ast.Attribute) and fn.attr == "emit"
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id == "EVENTS"):
+                continue
+            if not node.args or not isinstance(node.args[0], ast.Constant):
+                continue
+            kind = node.args[0].value
+            kw = [k.arg for k in node.keywords]
+            splat = any(k.arg is None for k in node.keywords)
+            yield str(path.relative_to(REPO)), node.lineno, kind, kw, splat
+
+
+def test_every_emit_call_site_matches_the_schema():
+    sites = list(_emit_call_sites())
+    assert len(sites) >= 30, "expected emit sites across the codebase"
+    for where, line, kind, kw, splat in sites:
+        assert kind in KINDS, f"{where}:{line} emits unknown kind {kind!r}"
+        if splat:
+            continue  # dynamic fields; runtime validation covers it
+        required = KINDS[kind][1]
+        missing = [f for f in required if f not in kw]
+        assert not missing, (
+            f"{where}:{line} emit({kind!r}) omits required {missing}"
+        )
+    # Every seam-wired kind family is actually referenced somewhere.
+    kinds_used = {k for _, _, k, _, _ in sites}
+    for expected in ("retry", "breaker_trip", "peer_failure",
+                     "gang_reformation", "watchdog_stall",
+                     "speculation_void", "checkpoint_commit",
+                     "warmup_complete", "slo_alert", "fatal"):
+        assert expected in kinds_used, f"no emit site for {expected}"

@@ -1,0 +1,395 @@
+"""Crash flight recorder (utils/events.flight_record) and fatal paths.
+
+Three layers, mirroring how a postmortem dump can be produced:
+
+* **Unit** (fast): the dump shape — schema tag, atomic tmp+rename (no
+  torn ``.tmp`` survivors), schema-valid ``events_recent``, per-kind
+  counts, the full metrics registry, exception details, and the SLO
+  snapshot when the engine is armed.  Plus the best-effort contract: an
+  unwritable base path returns ``None`` instead of raising into the
+  fatal path that called us.
+* **CLI fatal path** (fast, in-process): a run pointed at a missing
+  input dies of :exc:`PipelineError` with rc 1 — and leaves a
+  schema-valid ``<output>.flightrec/rank0.json`` whose journal tail
+  names ``fatal`` and ``run_end`` in order.
+* **2-process chaos** (slow): SIGKILL rank 1 mid-window under
+  ``--survive-peer-loss --events-file --slo`` — the survivor's journal
+  must name the peer failure, the reformation election, and the stripe
+  adoption in causal ``seq`` order on a non-decreasing aligned
+  timeline, and the merged run report must be v4 with gang-summed
+  event counts and an SLO section.
+
+The spawn helpers are standalone copies of tests/test_gang_reform.py's
+(same env contract) — importing across test modules would couple the
+suites' lifecycles.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from textblaster_tpu.cli import main
+from textblaster_tpu.data_model import TextDocument
+from textblaster_tpu.utils.events import (
+    EVENTS,
+    FLIGHTREC_SCHEMA,
+    flight_record,
+    validate_record,
+)
+from textblaster_tpu.utils.metrics import RUN_REPORT_SCHEMA
+from textblaster_tpu.utils.slo import SLO
+
+pytestmark = pytest.mark.events
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _recorder_hygiene():
+    EVENTS.close()
+    SLO.reset()
+    yield
+    SLO.reset()
+    EVENTS.close()
+
+
+# --- dump shape --------------------------------------------------------------
+
+
+def test_flight_record_dump_shape_and_atomicity(tmp_path):
+    EVENTS.configure(None, rank=2, incarnation=1)
+    SLO.configure({"availability": 0.99}, start_ticker=False)
+    EVENTS.emit("run_start")
+    EVENTS.emit("breaker_trip", seam="device", failures=3)
+    EVENTS.emit("fatal", reason="unit-test")
+
+    base = str(tmp_path / "out.parquet")
+    path = flight_record(
+        base, rank=2, reason="unit-test", exc=ValueError("boom")
+    )
+    assert path == str(tmp_path / "out.parquet.flightrec" / "rank2.json")
+    # Atomic tmp+rename: the finished directory holds no torn .tmp file.
+    assert os.listdir(tmp_path / "out.parquet.flightrec") == ["rank2.json"]
+
+    payload = json.loads(Path(path).read_text(encoding="utf-8"))
+    assert payload["schema"] == FLIGHTREC_SCHEMA
+    assert payload["reason"] == "unit-test"
+    assert payload["rank"] == 2
+    assert payload["incarnation"] == 1
+    assert payload["ts_us"] >= 0
+    assert payload["exception"] == {"type": "ValueError", "message": "boom"}
+    # The journal tail is schema-valid and ordered.
+    recent = payload["events_recent"]
+    assert [r["kind"] for r in recent] == ["run_start", "breaker_trip", "fatal"]
+    for rec in recent:
+        validate_record(rec)
+    assert [r["seq"] for r in recent] == [1, 2, 3]
+    assert payload["events_counts"] == {
+        "run_start": 1, "breaker_trip": 1, "fatal": 1,
+    }
+    assert payload["events_dropped"] == 0
+    # The full registry rides along; events counters are visible in it.
+    assert isinstance(payload["metrics"], dict)
+    assert payload["metrics"]["events_total_fatal"] >= 1
+    # SLO engine armed => its snapshot section is present.
+    assert payload["slo"]["enabled"] is True
+    assert payload["slo"]["objectives"] == {"availability": 0.99}
+
+
+def test_flight_record_is_best_effort_on_unwritable_path(tmp_path):
+    EVENTS.configure(None)
+    EVENTS.emit("run_start")
+    # `<base>.flightrec` cannot be created under a file — the dump must
+    # swallow the failure and report None, never raise into a fatal path.
+    blocker = tmp_path / "not-a-dir"
+    blocker.write_text("x", encoding="utf-8")
+    assert flight_record(str(blocker / "out.parquet")) is None
+
+
+def test_flight_record_without_slo_omits_the_section(tmp_path):
+    EVENTS.configure(None)
+    EVENTS.emit("run_start")
+    path = flight_record(str(tmp_path / "o.parquet"), reason="probe")
+    payload = json.loads(Path(path).read_text(encoding="utf-8"))
+    assert "slo" not in payload
+    assert payload["reason"] == "probe"
+
+
+# --- CLI fatal path ----------------------------------------------------------
+
+
+def test_cli_fatal_path_leaves_flight_recorder_dump(tmp_path):
+    (tmp_path / "cfg.yaml").write_text(
+        "pipeline:\n  - type: GopherQualityFilter\n    min_doc_words: 5\n",
+        encoding="utf-8",
+    )
+    out = tmp_path / "kept.parquet"
+    rc = main([
+        "run",
+        "-i", str(tmp_path / "missing-input.parquet"),
+        "-o", str(out),
+        "-e", str(tmp_path / "exc.parquet"),
+        "-c", str(tmp_path / "cfg.yaml"),
+        "--backend", "host",
+        "--quiet",
+        "--events-file", str(tmp_path / "events.jsonl"),
+    ])
+    assert rc == 1
+    dump = out.parent / "kept.parquet.flightrec" / "rank0.json"
+    assert dump.exists()
+    payload = json.loads(dump.read_text(encoding="utf-8"))
+    assert payload["schema"] == FLIGHTREC_SCHEMA
+    assert payload["reason"] == "pipeline_error"
+    # The concrete subclass (ParquetError) is an implementation detail;
+    # what matters is that the dying exception made it into the dump.
+    assert payload["exception"]["type"] in ("ParquetError", "PipelineError")
+    assert "missing-input.parquet" in payload["exception"]["message"]
+    kinds = [r["kind"] for r in payload["events_recent"]]
+    assert kinds[-2:] == ["fatal", "run_end"]
+    for rec in payload["events_recent"]:
+        validate_record(rec)
+    # The spilled journal agrees with the dump's tail.
+    lines = [
+        json.loads(line)
+        for line in (tmp_path / "events.jsonl")
+        .read_text(encoding="utf-8").splitlines()
+    ]
+    assert [r["kind"] for r in lines][-2:] == ["fatal", "run_end"]
+    fatal = next(r for r in lines if r["kind"] == "fatal")
+    assert fatal["severity"] == "critical"
+    assert fatal["data"]["reason"] == "pipeline_error"
+    run_end = lines[-1]
+    assert run_end["data"]["exit_code"] == 1
+
+
+# --- 2-process chaos ---------------------------------------------------------
+
+YAML = """
+pipeline:
+  - type: LanguageDetectionFilter
+    min_confidence: 0.5
+    allowed_languages: [ "dan", "eng" ]
+  - type: GopherQualityFilter
+    min_doc_words: 4
+    min_stop_words: 1
+    stop_words: [ "og", "the", "er", "i" ]
+"""
+
+
+def _docs(n=256):
+    base = [
+        "Det er en god dag i dag, og vi skal ud at gå en lang tur i skoven nu.",
+        "The quick brown fox jumps over the lazy dog and the old stone bridge.",
+        "kort.",
+        "Endnu en dansk tekst om vejret, og den er ganske lang og fin at læse.",
+        "Vi mødes nede ved havnen i morgen, og så sejler vi ud på vandet.",
+        ("En meget lang dansk tekst om byen og havnen og vejret, og den "
+         "bliver ved i mange ord. ") * 12,
+    ]
+    rng = np.random.default_rng(11)
+    docs = []
+    for i in range(n):
+        t = base[i % len(base)]
+        if rng.random() < 0.25:
+            t = t + " Og lidt mere tekst til sidst her."
+        docs.append(TextDocument(id=f"fr-{i}", source="s", content=t))
+    return docs
+
+
+def _write_input(dirpath, docs):
+    pq.write_table(
+        pa.table({
+            "id": [d.id for d in docs],
+            "text": [d.content for d in docs],
+            "source": [d.source for d in docs],
+        }),
+        dirpath / "input.parquet",
+    )
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_rank(tmp_path, pid, port, extra_args=()):
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        "PATH": "/usr/bin:/bin:/usr/local/bin",
+        "HOME": "/root",
+    }
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "textblaster_tpu.cli", "run",
+            "--coordinator", f"localhost:{port}",
+            "--num-processes", "2",
+            "--process-id", str(pid),
+            "-i", str(tmp_path / "input.parquet"),
+            "-o", str(tmp_path / "kept.parquet"),
+            "-e", str(tmp_path / "excluded.parquet"),
+            "-c", str(tmp_path / "cfg.yaml"),
+            "--buckets", "512,2048",
+            "--quiet",
+            *extra_args,
+        ],
+        cwd=str(REPO),
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+
+def _drain(proc, sink, timeout):
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+    if out:
+        sink.append(out)
+    return "".join(sink)
+
+
+def _posted_slots(membership_root, rank, seen) -> int:
+    for p in glob.glob(
+        os.path.join(membership_root, "exchange", "e*", "s*",
+                     f"rank{rank}.json")
+    ):
+        m = re.search(r"[/\\]e(\d+)[/\\]s(\d+)[/\\]", p)
+        if m:
+            seen.add((int(m.group(1)), int(m.group(2))))
+    return len(seen)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_sigkill_survivor_journal_names_the_failure_in_causal_order(tmp_path):
+    """The ISSUE acceptance scenario: SIGKILL rank 1 mid-window with the
+    journal and SLO engine armed.  The survivor's journal must contain
+    ``peer_failure -> gang_reform_start -> gang_reformation ->
+    stripe_adopted`` with strictly increasing ``seq`` and non-decreasing
+    ``ts_us``, and the merged run report must be v4 with the event counts
+    and SLO section built from the gang-merged snapshot."""
+    docs = _docs(256)
+    (tmp_path / "cfg.yaml").write_text(YAML, encoding="utf-8")
+    _write_input(tmp_path, docs)
+    membership_root = str(tmp_path / "kept.parquet.membership")
+    port = _free_port()
+    args = (
+        "--survive-peer-loss",
+        "--exchange-deadline-s", "6", "--lease-ttl-s", "2",
+        "--batch-size", "8",
+        "--events-file", str(tmp_path / "events.jsonl"),
+        "--slo", "availability=0.999",
+        "--run-report", str(tmp_path / "report.json"),
+    )
+    p0 = _spawn_rank(tmp_path, 0, port, args)
+    p1 = _spawn_rank(tmp_path, 1, port, args)
+    sink0, sink1 = [], []
+    try:
+        deadline = time.monotonic() + 420
+        killed = False
+        seen: set = set()
+        while time.monotonic() < deadline:
+            if _posted_slots(membership_root, 1, seen) >= 6:
+                if p1.poll() is None:
+                    os.kill(p1.pid, signal.SIGKILL)
+                    killed = True
+                break
+            if p1.poll() is not None or p0.poll() is not None:
+                break
+            time.sleep(0.01)
+        if not killed:
+            pytest.skip(
+                "rank 1 finished before the kill could land mid-window:\n"
+                + _drain(p1, sink1, timeout=30)[-1500:]
+            )
+        out0 = _drain(p0, sink0, timeout=420)
+        assert p0.returncode == 0, out0[-4000:]
+    finally:
+        for p in (p0, p1):
+            if p.poll() is None:
+                p.kill()
+        _drain(p1, sink1, timeout=30)
+
+    # Rank 0 owns the bare journal path; rank 1 got a .host1 suffix so the
+    # two never clobbered each other.
+    journal = [
+        json.loads(line)
+        for line in (tmp_path / "events.jsonl")
+        .read_text(encoding="utf-8").splitlines()
+    ]
+    assert journal, "survivor journal is empty"
+    for rec in journal:
+        validate_record(rec)
+    seqs = [r["seq"] for r in journal]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+
+    def first(kind):
+        for rec in journal:
+            if rec["kind"] == kind:
+                return rec
+        raise AssertionError(
+            f"journal never recorded {kind!r}; kinds seen: "
+            f"{sorted({r['kind'] for r in journal})}"
+        )
+
+    failure = first("peer_failure")
+    reform_start = first("gang_reform_start")
+    reformation = first("gang_reformation")
+    adoption = first("stripe_adopted")
+    chain = [failure, reform_start, reformation, adoption]
+    assert [r["seq"] for r in chain] == sorted(r["seq"] for r in chain)
+    assert all(a["seq"] < b["seq"] for a, b in zip(chain, chain[1:]))
+    ts = [r["ts_us"] for r in chain]
+    assert ts == sorted(ts)
+    assert failure["severity"] == "critical"
+    assert 1 in failure["data"]["missing_ranks"]
+    assert reformation["data"]["world_size"] == 1
+    assert adoption["data"]["stripe"] == 1
+    # Post-reformation records carry the bumped incarnation stamp.
+    assert adoption["incarnation"] > failure["incarnation"]
+    # The run closed out cleanly in the journal too.
+    assert journal[0]["kind"] == "run_start"
+    assert journal[-1]["kind"] == "run_end"
+    assert journal[-1]["data"]["exit_code"] == 0
+
+    # Merged run report: v4 schema, gang-summed event counts naming the
+    # failure chain, and the SLO section rebuilt from merged counters.
+    report = json.loads((tmp_path / "report.json").read_text(encoding="utf-8"))
+    assert report["schema"] == RUN_REPORT_SCHEMA
+    ev = report["events"]
+    # The report snapshot is taken before the run's closing records
+    # (run_end spills after the merge), so totals may trail the journal
+    # by the tail — but every failure-chain kind is fully counted.
+    chain_kinds = ("peer_failure", "gang_reform_start", "gang_reformation",
+                   "stripe_adopted")
+    jkinds: dict = {}
+    for rec in journal:
+        jkinds[rec["kind"]] = jkinds.get(rec["kind"], 0) + 1
+    for kind in chain_kinds:
+        assert ev["by_kind"].get(kind, 0) >= jkinds[kind], ev["by_kind"]
+    assert ev["emitted_total"] >= sum(jkinds[k] for k in chain_kinds) + 1
+    slo = report["slo"]
+    avail = slo["objectives"]["availability"]
+    assert avail["target"] == 0.999
+    assert avail["events"] > 0
+    assert isinstance(slo["alerts_total"], int)
+    assert report["resilience"]["multihost_gang_reformations_total"] == 1

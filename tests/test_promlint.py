@@ -14,8 +14,11 @@ import re
 from textblaster_tpu.utils.metrics import (
     DEVICE_BPS_PREFIX,
     DEVICE_TIME_PREFIX,
+    EVENT_KIND_PREFIX,
     FILTER_DROP_PREFIX,
     OCCUPANCY_BUCKET_PREFIX,
+    SLO_BAD_EVENTS_PREFIX,
+    SLO_EVENTS_PREFIX,
     Metrics,
 )
 
@@ -67,6 +70,23 @@ def _populated_registry() -> Metrics:
         m.observe_hdr(DEVICE_TIME_PREFIX + "256_phase_0_seconds", us)
     m.observe_hdr(DEVICE_TIME_PREFIX + "512_phase_1_seconds", 9_000)
     m.set(DEVICE_BPS_PREFIX + "256_phase_0", 1.25e9)
+    # Operational event-journal families: the three static counters plus a
+    # couple of per-kind dynamic counters.
+    m.inc("events_emitted_total", 6)
+    m.inc("events_dropped_total", 1)
+    m.inc("events_invalid_total", 1)
+    m.inc(EVENT_KIND_PREFIX + "breaker_trip", 2)
+    m.inc(EVENT_KIND_PREFIX + "watchdog_stall", 1)
+    # SLO-engine families: per-objective event/bad-event counters and the
+    # target/burn/budget gauge triple, plus the alert counter and the
+    # warmup-readiness gauge the /healthz endpoint reads.
+    m.inc("slo_alerts_total", 1)
+    m.set("pipeline_warmup_done", 1)
+    m.inc(SLO_EVENTS_PREFIX + "availability", 120)
+    m.inc(SLO_BAD_EVENTS_PREFIX + "availability", 3)
+    m.set("slo_target_availability", 0.999)
+    m.set("slo_burn_rate_availability", 2.5)
+    m.set("slo_budget_remaining_availability", 0.4)
     return m
 
 

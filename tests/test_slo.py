@@ -1,0 +1,339 @@
+"""SLO engine (utils/slo.py): burn-rate math, alerting, merge algebra,
+health/SLO endpoints, and env-knob hygiene.
+
+* ``--slo KEY=TARGET`` parse grammar accepts the closed key set and
+  rejects malformed targets with operator-readable messages.
+* Burn-rate math per objective: availability from the worker outcome
+  counters, p99 latency from the HDR histogram's count-above-threshold
+  (additive over buckets, so it merges exactly), throughput from
+  per-tick pass/fail events.
+* Multi-window alerting is edge-triggered — one ``slo_alert`` journal
+  event per excursion, one ``slo_resolved`` on recovery — and requires
+  BOTH windows above threshold.
+* ``slo_report`` built from a gang-merged flat snapshot equals the
+  bucket-wise merge of the per-rank snapshots (counters sum, gauges max).
+* ``/healthz`` flips ready -> degraded -> ready across a real breaker
+  trip/recovery, scraped live over HTTP; ``/slo`` serves engine state.
+* TEXTBLAST_EVENTS / TEXTBLAST_SLO sit in the profiler's scheduling-knob
+  list and are absent from the AOT trace-key env set.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from textblaster_tpu.resilience.breaker import CircuitBreaker
+from textblaster_tpu.utils.events import EVENTS, validate_record
+from textblaster_tpu.utils.metrics import (
+    METRICS,
+    is_merge_gauge,
+    setup_prometheus_metrics,
+)
+from textblaster_tpu.utils.slo import (
+    SLO,
+    health_snapshot,
+    parse_slo_arg,
+    slo_report,
+)
+
+pytestmark = pytest.mark.events
+
+
+@pytest.fixture(autouse=True)
+def _slo_hygiene():
+    EVENTS.close()
+    SLO.reset()
+    saved = {
+        k: METRICS.get(k)
+        for k in ("pipeline_warmup_done", "resilience_breaker_open")
+    }
+    yield
+    SLO.reset()
+    EVENTS.close()
+    for k, v in saved.items():
+        METRICS.set(k, v)
+
+
+def _arm(objectives, **kw):
+    kw.setdefault("start_ticker", False)
+    SLO.configure(objectives, **kw)
+    return SLO._t0
+
+
+# --- parse grammar -----------------------------------------------------------
+
+
+def test_parse_slo_arg_accepts_the_closed_key_set():
+    assert parse_slo_arg("availability=0.999") == ("availability", 0.999)
+    assert parse_slo_arg(" p99_latency_s = 0.25 ") == ("p99_latency_s", 0.25)
+    assert parse_slo_arg("throughput_floor=500") == ("throughput_floor", 500.0)
+
+
+@pytest.mark.parametrize("bad,needle", [
+    ("availability", "KEY=TARGET"),
+    ("error_rate=0.1", "unknown SLO key"),
+    ("availability=fast", "not a number"),
+    ("availability=1.5", "in (0, 1]"),
+    ("availability=0", "in (0, 1]"),
+    ("throughput_floor=-3", "must be > 0"),
+])
+def test_parse_slo_arg_rejects_malformations(bad, needle):
+    with pytest.raises(ValueError) as ei:
+        parse_slo_arg(bad)
+    assert needle in str(ei.value)
+
+
+# --- burn math per objective -------------------------------------------------
+
+
+def test_availability_burn_and_budget():
+    t0 = _arm({"availability": 0.99})
+    METRICS.inc("producer_results_received_total", 100)
+    METRICS.inc("producer_results_error_total", 10)
+    state = SLO.evaluate(now=t0 + 1.0)["availability"]
+    # 10 bad of 100 against a 1% budget: burning 10x.
+    assert state["bad"] == 10 and state["total"] == 100
+    assert state["burn_rate"] == pytest.approx(10.0)
+    assert state["burn_fast"] == pytest.approx(10.0)
+    assert state["budget_remaining"] == 0.0
+    assert METRICS.get("slo_burn_rate_availability") == pytest.approx(10.0)
+    assert METRICS.get("slo_events_total_availability") == 100
+    assert METRICS.get("slo_bad_events_total_availability") == 10
+    assert METRICS.get("slo_target_availability") == pytest.approx(0.99)
+
+
+def test_availability_baseline_excludes_prerun_errors():
+    METRICS.inc("producer_results_received_total", 25)  # history, not this run
+    METRICS.inc("producer_results_error_total", 25)
+    t0 = _arm({"availability": 0.99})
+    METRICS.inc("producer_results_received_total", 100)
+    state = SLO.evaluate(now=t0 + 1.0)["availability"]
+    assert state["bad"] == 0
+    assert state["budget_remaining"] == 1.0
+
+
+def test_p99_latency_counts_bucket_mass_above_threshold():
+    t0 = _arm({"p99_latency_s": 0.5})
+    for us in (10_000, 200_000, 700_000, 2_000_000):
+        METRICS.observe_hdr("doc_latency_e2e_seconds", us)
+    state = SLO.evaluate(now=t0 + 1.0)["p99_latency_s"]
+    # Two of four samples sit in buckets whose upper bound exceeds 0.5s.
+    assert state["bad"] == 2 and state["total"] == 4
+    assert state["burn_rate"] == pytest.approx((2 / 4) / 0.01)
+
+
+def test_throughput_floor_ticks_pass_fail():
+    t0 = _arm({"throughput_floor": 50.0})
+    METRICS.inc("producer_results_received_total", 100)
+    SLO.evaluate(now=t0 + 1.0)   # first tick: primes the rate window
+    METRICS.inc("producer_results_received_total", 100)
+    SLO.evaluate(now=t0 + 2.0)   # 100 docs/s >= 50: pass
+    SLO.evaluate(now=t0 + 3.0)   # 0 docs/s < 50: fail
+    state = SLO.evaluate(now=t0 + 4.0)["throughput_floor"]  # fail again
+    assert state["total"] == 3
+    assert state["bad"] == 2
+
+
+# --- alerting ----------------------------------------------------------------
+
+
+def test_alerts_are_edge_triggered_and_journaled():
+    EVENTS.configure(None)
+    alerts_before = METRICS.get("slo_alerts_total")
+    t0 = _arm({"availability": 0.99}, fast_window_s=1.0, slow_window_s=2.0)
+    METRICS.inc("producer_results_received_total", 100)
+    METRICS.inc("producer_results_error_total", 50)
+    SLO.evaluate(now=t0 + 0.5)          # burning hard: alert fires
+    SLO.evaluate(now=t0 + 1.0)          # still burning: no re-fire
+    assert SLO.active_alerts() == ["availability"]
+    assert METRICS.get("slo_alerts_total") - alerts_before == 1
+    # Recovery: flood with successes until both windows drop under 1x.
+    METRICS.inc("producer_results_received_total", 100_000)
+    SLO.evaluate(now=t0 + 4.0)
+    SLO.evaluate(now=t0 + 7.0)
+    assert SLO.active_alerts() == []
+    records = EVENTS.drain()
+    kinds = [r["kind"] for r in records]
+    assert kinds.count("slo_alert") == 1
+    assert kinds.count("slo_resolved") == 1
+    for r in records:
+        validate_record(r)
+    alert = next(r for r in records if r["kind"] == "slo_alert")
+    assert alert["data"]["key"] == "availability"
+    assert alert["data"]["burn_rate"] > 1.0
+
+
+def test_alert_requires_both_windows_above_threshold():
+    t0 = _arm({"availability": 0.9}, fast_window_s=1.0, slow_window_s=6.0)
+    # A long clean prefix fills the slow window with good events.
+    METRICS.inc("producer_results_received_total", 10_000)
+    for dt in (1.0, 2.0, 3.0, 4.0, 5.0):
+        SLO.evaluate(now=t0 + dt)
+    # A one-tick blip: the fast window burns, the slow window stays calm.
+    METRICS.inc("producer_results_received_total", 30)
+    METRICS.inc("producer_results_error_total", 30)
+    SLO.evaluate(now=t0 + 6.0)
+    assert SLO.active_alerts() == []
+
+
+# --- merge algebra -----------------------------------------------------------
+
+
+def _merge(snapshots):
+    """The multihost all_values merge: counters sum, gauges max."""
+    merged = {}
+    for snap in snapshots:
+        for k, v in snap.items():
+            if is_merge_gauge(k):
+                merged[k] = max(merged.get(k, float("-inf")), v)
+            else:
+                merged[k] = merged.get(k, 0.0) + v
+    return merged
+
+
+def test_merged_slo_report_equals_bucketwise_merge_of_ranks():
+    rank0 = {
+        "slo_target_availability": 0.99,
+        "slo_events_total_availability": 600.0,
+        "slo_bad_events_total_availability": 3.0,
+        "slo_burn_rate_availability": 0.5,
+        "slo_budget_remaining_availability": 0.5,
+        "slo_alerts_total": 1.0,
+    }
+    rank1 = {
+        "slo_target_availability": 0.99,
+        "slo_events_total_availability": 400.0,
+        "slo_bad_events_total_availability": 7.0,
+        "slo_burn_rate_availability": 1.75,
+        "slo_budget_remaining_availability": 0.3,
+        "slo_alerts_total": 2.0,
+    }
+    merged = _merge([rank0, rank1])
+    report = slo_report(None, merged)
+    obj = report["objectives"]["availability"]
+    # Counter-derived numbers equal the sums over ranks exactly.
+    assert obj["events"] == 1000
+    assert obj["bad_events"] == 10
+    assert obj["bad_frac"] == pytest.approx(0.01)
+    assert obj["burn_rate"] == pytest.approx(1.0)
+    assert report["alerts_total"] == 3
+    # Target gauges must max-merge, not sum — the regression this guards.
+    assert merged["slo_target_availability"] == pytest.approx(0.99)
+    assert is_merge_gauge("slo_target_availability")
+    assert is_merge_gauge("slo_burn_rate_availability")
+    assert is_merge_gauge("slo_budget_remaining_availability")
+    assert not is_merge_gauge("slo_events_total_availability")
+    assert not is_merge_gauge("slo_alerts_total")
+
+
+def test_slo_report_empty_without_objectives():
+    assert slo_report(None, {"producer_results_received_total": 5.0}) == {}
+
+
+# --- health + endpoints ------------------------------------------------------
+
+
+def test_healthz_flips_ready_degraded_ready_over_live_scrape():
+    server = setup_prometheus_metrics(0)
+    assert server is not None
+    port = server.server_address[1]
+
+    def scrape(path):
+        try:
+            with urllib.request.urlopen(
+                f"http://localhost:{port}{path}", timeout=10
+            ) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    try:
+        METRICS.set("pipeline_warmup_done", 0)
+        code, body = scrape("/healthz")
+        assert code == 503 and body["status"] == "starting"
+        assert body["live"] is True and body["ready"] is False
+
+        METRICS.set("pipeline_warmup_done", 1)
+        METRICS.set("resilience_breaker_open", 0)
+        code, body = scrape("/healthz")
+        assert code == 200 and body["status"] == "ok" and body["ready"]
+
+        fake_now = [0.0]
+        breaker = CircuitBreaker(threshold=2, cooldown_s=5.0,
+                                 name="healthz-test",
+                                 clock=lambda: fake_now[0])
+        breaker.record_failure("boom")
+        breaker.record_failure("boom")  # trips: gauge goes to 1
+        code, body = scrape("/healthz")
+        assert code == 503 and body["status"] == "degraded"
+        assert body["components"]["breaker_open"] is True
+
+        # Recovery path: cooldown elapses, the half-open probe succeeds,
+        # the breaker closes and the gauge drops back to 0.
+        fake_now[0] = 10.0
+        assert breaker.allow_request()  # grants the probe
+        breaker.record_success()
+        code, body = scrape("/healthz")
+        assert code == 200 and body["status"] == "ok" and body["ready"]
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_healthz_degrades_on_new_watchdog_escalation_then_recovers():
+    METRICS.set("pipeline_warmup_done", 1)
+    METRICS.set("resilience_breaker_open", 0)
+    health_snapshot()  # sync the seen-escalations watermark
+    METRICS.inc("watchdog_escalations_total")
+    code, body = health_snapshot()
+    assert code == 503 and body["components"]["new_escalation"]
+    code, body = health_snapshot()  # next scrape: no NEW escalation
+    assert code == 200 and not body["components"]["new_escalation"]
+
+
+def test_healthz_degrades_while_slo_alert_fires():
+    METRICS.set("pipeline_warmup_done", 1)
+    METRICS.set("resilience_breaker_open", 0)
+    health_snapshot()
+    t0 = _arm({"availability": 0.99}, fast_window_s=1.0, slow_window_s=2.0)
+    METRICS.inc("producer_results_error_total", 50)
+    METRICS.inc("producer_results_received_total", 100)
+    SLO.evaluate(now=t0 + 0.5)
+    code, body = health_snapshot()
+    assert code == 503
+    assert body["components"]["slo_alerts"] == ["availability"]
+
+
+def test_slo_endpoint_serves_engine_snapshot():
+    server = setup_prometheus_metrics(0)
+    assert server is not None
+    port = server.server_address[1]
+    try:
+        t0 = _arm({"availability": 0.999})
+        SLO.evaluate(now=t0 + 1.0)
+        with urllib.request.urlopen(
+            f"http://localhost:{port}/slo", timeout=10
+        ) as resp:
+            assert resp.status == 200
+            body = json.loads(resp.read())
+        assert body["enabled"] is True
+        assert body["objectives"] == {"availability": 0.999}
+        assert "availability" in body["state"]
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+# --- env-knob hygiene --------------------------------------------------------
+
+
+def test_events_slo_knobs_are_scheduling_only():
+    from textblaster_tpu.utils.compile_cache import _TRACE_ENV_KNOBS
+    from textblaster_tpu.utils.profiler import _SCHEDULING_ENV_KNOBS
+
+    for knob in ("TEXTBLAST_EVENTS", "TEXTBLAST_SLO"):
+        assert knob in _SCHEDULING_ENV_KNOBS
+        # Observability must never key AOT executables: a journal path in
+        # the trace-key env would split the compile cache for no reason.
+        assert knob not in _TRACE_ENV_KNOBS

@@ -240,7 +240,7 @@ def test_latency_report_reads_deltas_against_baseline():
 
 
 def test_run_report_v3_has_latency_and_histogram_sections():
-    assert RUN_REPORT_SCHEMA == "textblaster-run-report/v3"
+    assert RUN_REPORT_SCHEMA == "textblaster-run-report/v4"
     m = Metrics()
     m.observe_hdr("doc_latency_e2e_seconds", 5000)
     m.observe("worker_task_processing_duration_seconds", 0.01)

@@ -52,6 +52,7 @@ from .resilience.deadletter import (
     read_error_row,
 )
 from .resilience.faults import FAULTS
+from .utils.events import EVENTS
 from .resilience.retry import RetryPolicy
 from .utils.metrics import METRICS
 
@@ -167,6 +168,9 @@ class CheckpointState:
                 os.close(dir_fd)
 
         policy.run(commit, seam="checkpoint")
+        if EVENTS.enabled:
+            EVENTS.emit("checkpoint_commit", chunk=len(self.out_parts),
+                        rows_consumed=self.rows_consumed)
 
     @classmethod
     def load(cls, ckpt_dir: str) -> Optional["CheckpointState"]:
@@ -221,6 +225,9 @@ class CheckpointState:
                     "directory to start over"
                 )
         state.owner = dict(owner)
+        if EVENTS.enabled:
+            EVENTS.emit("checkpoint_adopted", owner=dict(owner),
+                        rows_consumed=state.rows_consumed)
         state.save(ckpt_dir, retry_policy)
         return state
 

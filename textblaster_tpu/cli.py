@@ -34,7 +34,9 @@ from .utils.metrics import (
     write_run_report,
 )
 from .resilience.watchdog import WATCHDOG
+from .utils.events import EVENTS, flight_record
 from .utils.profiler import PROFILER
+from .utils.slo import SLO, parse_slo_arg
 from .utils.telemetry import TELEMETRY, format_latency_summary
 from .utils.trace import TRACER, device_profile
 
@@ -156,6 +158,32 @@ def build_parser() -> argparse.ArgumentParser:
                           "jax.profiler.trace into LOGDIR (TensorBoard/"
                           "Perfetto-loadable).  Opt-in and independent of "
                           "--trace")
+    run.add_argument("--events-file", default=None, metavar="OUT.JSONL",
+                     help="Write the structured operational event journal: "
+                          "every retry/breaker/ladder transition, negotiated "
+                          "verdict, peer failure, reformation, membership "
+                          "change, watchdog stall, speculation void, "
+                          "checkpoint commit, and warmup outcome as one "
+                          "schema-validated JSONL record, sequence-numbered "
+                          "and stamped on the aligned trace clock so "
+                          "multi-host journals interleave.  Near-zero cost "
+                          "when off; with --coordinator, process i>0 writes "
+                          "OUT.JSONL.host<i>.  TEXTBLAST_EVENTS sets the "
+                          "same path from the environment")
+    run.add_argument("--slo", action="append", default=None,
+                     metavar="KEY=TARGET",
+                     help="Declare a service-level objective (repeatable): "
+                          "availability=0.999, p99_latency_s=0.25 (needs "
+                          "--doc-sample-rate), throughput_floor=500.  The "
+                          "engine evaluates multi-window burn rates against "
+                          "the error budget, publishes slo_* gauges on "
+                          "/metrics and /slo, fires edge-triggered "
+                          "slo_alert journal events, and lands an `slo` "
+                          "section in the run report.  Overrides the "
+                          "config's `slo:` block per key; TEXTBLAST_SLO "
+                          "takes comma-separated pairs from the "
+                          "environment.  Arms the event journal (ring "
+                          "buffer only unless --events-file is also given)")
     run.add_argument("--run-report", default=None, metavar="REPORT.JSON",
                      help="Write a machine-readable end-of-run report "
                           "(stage breakdown, occupancy, resilience "
@@ -379,6 +407,40 @@ def _cmd_run(args: argparse.Namespace) -> int:
         PROFILER.configure()
     WATCHDOG.configure(config.resilience.stage_deadline_s)
 
+    # SLO objectives: config block first, --slo overrides per key, the env
+    # fallback only when no flag was passed (mirrors TEXTBLAST_STAGE_DEADLINE_S).
+    slo_pairs = list(args.slo or [])
+    if not slo_pairs and os.environ.get("TEXTBLAST_SLO", "").strip():
+        slo_pairs = [
+            s for s in os.environ["TEXTBLAST_SLO"].split(",") if s.strip()
+        ]
+    slo_objectives = dict(config.slo.objectives)
+    for raw in slo_pairs:
+        try:
+            key, target = parse_slo_arg(raw)
+        except ValueError as e:
+            print(f"Invalid --slo value: {e}", file=sys.stderr)
+            return 1
+        slo_objectives[key] = target
+
+    events_path = args.events_file or (
+        os.environ.get("TEXTBLAST_EVENTS", "").strip() or None
+    )
+    if events_path or slo_objectives:
+        # Objectives without a journal path still arm the ring buffer:
+        # slo_alert events must land somewhere the flight recorder can see.
+        journal_path = events_path
+        if journal_path and args.coordinator and args.process_id:
+            journal_path = f"{events_path}.host{args.process_id}"
+        EVENTS.configure(journal_path, rank=args.process_id)
+    if slo_objectives:
+        SLO.configure(
+            slo_objectives,
+            fast_window_s=config.slo.fast_window_s,
+            slow_window_s=config.slo.slow_window_s,
+            burn_threshold=config.slo.burn_threshold,
+            tick_s=config.slo.tick_s,
+        )
     provenance = {
         "entry": "textblast run",
         "version": __version__,
@@ -399,9 +461,20 @@ def _cmd_run(args: argparse.Namespace) -> int:
         "doc_sample_rate": int(args.doc_sample_rate),
         "profile": bool(args.profile),
         "stage_deadline_s": float(config.resilience.stage_deadline_s),
+        "events_file": args.events_file,
+        "slo": dict(sorted(slo_objectives.items())) or None,
     }
     report_baseline = metrics_snapshot() if args.run_report else None
     funnel_before = funnel_snapshot()
+    if EVENTS.enabled:
+        # After the baseline snapshot, so the report's events section
+        # charges run_start to this run rather than to history.
+        EVENTS.emit(
+            "run_start",
+            input=args.input_file,
+            backend=args.backend,
+            num_processes=args.num_processes,
+        )
 
     start = time.perf_counter()
     fallbacks_before = METRICS.get("worker_host_fallback_total")
@@ -580,6 +653,8 @@ def _cmd_run(args: argparse.Namespace) -> int:
                 errors_file=args.errors_file,
                 warmup=warmup_opt,
             )
+        if EVENTS.enabled:
+            EVENTS.emit("run_end", exit_code=0)
     except PeerFailure as e:
         # A dead gang member: run_multihost already abandoned the
         # distributed client, but the coordination service's C++ error
@@ -587,6 +662,24 @@ def _cmd_run(args: argparse.Namespace) -> int:
         # mid-exit.  Flush the diagnosis and hard-exit deterministically —
         # there is no graceful path out of a broken gang.
         print(f"Pipeline run failed: {e}", file=sys.stderr, flush=True)
+        if EVENTS.enabled:
+            # Journal the diagnosis and leave a flight-recorder dump beside
+            # the output before the hard exit — the dump is the post-mortem
+            # when the gang dies faster than any scrape.
+            EVENTS.emit(
+                "fatal",
+                reason="peer_failure",
+                missing_ranks=list(e.missing_ranks),
+                dead_ranks=list(e.dead_ranks),
+                seq=e.seq,
+            )
+            EVENTS.emit("run_end", exit_code=1)
+            flight_record(
+                args.output_file,
+                rank=args.process_id,
+                reason="peer_failure",
+                exc=e,
+            )
         if args.run_report:
             # Post-mortems of unreformable gangs shouldn't be blind: commit
             # a partial, schema-tagged report naming the failed exchange
@@ -612,16 +705,42 @@ def _cmd_run(args: argparse.Namespace) -> int:
                 pass
         profile_ctx.__exit__(None, None, None)
         TRACER.close()  # flushes the trace spill to disk
+        SLO.close()
+        EVENTS.close()  # flushes the journal spill; os._exit skips finally
         sys.stdout.flush()
         os._exit(1)
     except PipelineError as e:
         print(f"Pipeline run failed: {e}", file=sys.stderr)
+        if EVENTS.enabled:
+            EVENTS.emit("fatal", reason="pipeline_error", error=str(e))
+            EVENTS.emit("run_end", exit_code=1)
+            flight_record(
+                args.output_file,
+                rank=args.process_id,
+                reason="pipeline_error",
+                exc=e,
+            )
         return 1
+    except BaseException as e:
+        # Anything else escaping here (KeyboardInterrupt, MemoryError, a
+        # plain bug) unwinds the interpreter: leave the flight-recorder
+        # dump behind first, then let it propagate.
+        if EVENTS.enabled:
+            EVENTS.emit("fatal", reason=type(e).__name__)
+            flight_record(
+                args.output_file,
+                rank=args.process_id,
+                reason="unhandled",
+                exc=e,
+            )
+        raise
     finally:
         profile_ctx.__exit__(None, None, None)
         TRACER.close()
         TELEMETRY.close()  # stops the rollup ticker; HDR state stays in METRICS
         PROFILER.close()  # stops recording; captured state stays for the report
+        SLO.close()  # final evaluation tick, then disarm
+        EVENTS.close()  # flushes the journal spill; counters stay in METRICS
 
     elapsed = time.perf_counter() - start
     total = result.received
@@ -744,6 +863,28 @@ def _cmd_run(args: argparse.Namespace) -> int:
         if args.trace:
             print(f"Trace written -> {args.trace} "
                   "(load at https://ui.perfetto.dev)", file=sys.stderr)
+        if args.events_file:
+            emitted = int(METRICS.get("events_emitted_total"))
+            dropped = int(METRICS.get("events_dropped_total"))
+            line = f"Event journal -> {args.events_file} ({emitted} events"
+            if dropped:
+                line += f", {dropped} dropped"
+            print(line + ")", file=sys.stderr)
+        if slo_objectives:
+            alerts = int(METRICS.get("slo_alerts_total"))
+            worst = min(
+                (
+                    METRICS.get(f"slo_budget_remaining_{k}")
+                    for k in slo_objectives
+                ),
+                default=1.0,
+            )
+            print(
+                f"SLO: {len(slo_objectives)} objective(s), {alerts} "
+                f"alert(s), {worst * 100.0:.1f}% of the tightest error "
+                "budget left.",
+                file=sys.stderr,
+            )
 
     if args.run_report and not args.coordinator:
         # Coordinator runs write the merged report from run_multihost

@@ -28,6 +28,7 @@ __all__ = [
     "TokenCounterParams",
     "ResilienceConfig",
     "OverlapConfig",
+    "SLOConfig",
     "load_pipeline_config",
     "parse_pipeline_config",
 ]
@@ -434,6 +435,96 @@ class OverlapConfig:
 
 
 @dataclass
+class SLOConfig:
+    """Service-level objectives for the run (no reference equivalent).
+
+    Parsed from an optional top-level ``slo:`` mapping in the pipeline
+    YAML: objective keys map directly to targets, engine knobs ride in
+    the same mapping::
+
+        slo:
+          availability: 0.999
+          p99_latency_s: 0.25
+          fast_window_s: 30
+
+    ``--slo KEY=TARGET`` on the command line overrides per key.  Like
+    ``resilience`` and ``overlap``, excluded from the checkpoint config
+    fingerprint (checkpoint.py hashes ``config.pipeline`` only):
+    objectives judge a run, they never change its outputs.
+    """
+
+    objectives: Dict[str, float] = field(default_factory=dict)
+    fast_window_s: float = 60.0     # fast burn-rate window
+    slow_window_s: float = 300.0    # slow burn-rate window
+    burn_threshold: float = 1.0     # alert iff BOTH windows burn above this
+    tick_s: float = 5.0             # evaluation cadence
+
+    #: Engine knobs that live beside the objectives in the ``slo:`` block.
+    _KNOBS = ("fast_window_s", "slow_window_s", "burn_threshold", "tick_s")
+
+    def validate(self) -> None:
+        # The objective vocabulary is owned by utils.slo (single source of
+        # truth with --slo parsing); imported lazily to keep config loading
+        # free of the observability stack.
+        from ..utils.slo import SLO_KEYS
+
+        for key, target in self.objectives.items():
+            if key not in SLO_KEYS:
+                raise ConfigValidationError(
+                    f"SLOConfig: unknown objective {key!r} "
+                    f"(keys: {', '.join(SLO_KEYS)})"
+                )
+            try:
+                target = float(target)
+            except (TypeError, ValueError):
+                raise ConfigValidationError(
+                    f"SLOConfig: target for {key} must be a number, "
+                    f"got {target!r}"
+                )
+            if key == "availability" and not 0.0 < target <= 1.0:
+                raise ConfigValidationError(
+                    "SLOConfig: availability target must be in (0, 1], "
+                    f"got {target}"
+                )
+            if key != "availability" and target <= 0:
+                raise ConfigValidationError(
+                    f"SLOConfig: {key} target must be > 0, got {target}"
+                )
+        for name in ("fast_window_s", "slow_window_s", "tick_s"):
+            if getattr(self, name) <= 0:
+                raise ConfigValidationError(
+                    f"SLOConfig: {name} must be positive, "
+                    f"got {getattr(self, name)}"
+                )
+        if self.burn_threshold <= 0:
+            raise ConfigValidationError(
+                "SLOConfig: burn_threshold must be positive, "
+                f"got {self.burn_threshold}"
+            )
+        if self.fast_window_s > self.slow_window_s:
+            raise ConfigValidationError(
+                "SLOConfig: fast_window_s must not exceed slow_window_s "
+                f"({self.fast_window_s} > {self.slow_window_s})"
+            )
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "SLOConfig":
+        if not isinstance(d, dict):
+            raise ConfigError("`slo` must be a mapping")
+        knobs = {k: v for k, v in d.items() if k in cls._KNOBS}
+        objectives = {
+            k: v for k, v in d.items() if k not in cls._KNOBS
+        }
+        try:
+            return cls(
+                objectives={k: float(v) for k, v in objectives.items()},
+                **{k: float(v) for k, v in knobs.items()},
+            )
+        except (TypeError, ValueError) as e:
+            raise ConfigError(f"invalid slo config: {e}") from e
+
+
+@dataclass
 class StepConfig:
     """One pipeline step: a type tag + typed params (pipeline.rs:26-64)."""
 
@@ -490,12 +581,14 @@ class PipelineConfig:
     pipeline: List[StepConfig]
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     overlap: OverlapConfig = field(default_factory=OverlapConfig)
+    slo: SLOConfig = field(default_factory=SLOConfig)
 
     def validate(self) -> None:
         for step in self.pipeline:
             step.validate()
         self.resilience.validate()
         self.overlap.validate()
+        self.slo.validate()
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "PipelineConfig":
@@ -506,6 +599,7 @@ class PipelineConfig:
             raise ConfigError("`pipeline` must be a list of steps")
         resilience_raw = d.get("resilience")
         overlap_raw = d.get("overlap")
+        slo_raw = d.get("slo")
         return cls(
             pipeline=[StepConfig.from_dict(s) for s in steps_raw],
             resilience=(
@@ -517,6 +611,11 @@ class PipelineConfig:
                 OverlapConfig.from_dict(overlap_raw)
                 if overlap_raw is not None
                 else OverlapConfig()
+            ),
+            slo=(
+                SLOConfig.from_dict(slo_raw)
+                if slo_raw is not None
+                else SLOConfig()
             ),
         )
 

@@ -56,6 +56,7 @@ from ..resilience.watchdog import WATCHDOG
 from ..utils.metrics import FILTER_DROP_PREFIX, METRICS
 from ..utils.profiler import PROFILER
 from ..utils.telemetry import TELEMETRY
+from ..utils.events import EVENTS
 from ..utils.trace import TRACER
 from ..utils.overlap import prefetch_iter
 from .badwords import badwords_matches_multi
@@ -383,16 +384,23 @@ def maybe_warmup(
     checkpointed, multi-host) funnels through this so the AOT executable
     cache is consulted uniformly.  Returns the stats, or None if skipped."""
     if pipeline.fully_host or not pipeline.device_steps:
+        METRICS.set("pipeline_warmup_done", 1)
         return None
     if not should_warmup(warmup):
+        METRICS.set("pipeline_warmup_done", 1)
         return None
     ws = pipeline.warmup_parallel()
+    METRICS.set("pipeline_warmup_done", 1)
     logger.info(
         "warmup: %d programs in %.2fs (trace %.2fs, compile %.2fs, "
         "cache-load %.2fs, %d/%d AOT hits)",
         ws.programs, ws.total_s, ws.trace_s, ws.compile_s,
         ws.cache_load_s, ws.cache_hits, ws.programs,
     )
+    if EVENTS.enabled:
+        EVENTS.emit("warmup_complete", programs=ws.programs,
+                    total_s=round(ws.total_s, 3), cache_hits=ws.cache_hits,
+                    cache_misses=ws.cache_misses, compile_s=round(ws.compile_s, 3))
     return ws
 
 
@@ -1732,6 +1740,9 @@ class CompiledPipeline:
             TRACER.instant(
                 "ladder_split", {"bucket": batch.max_len, "phase": phase}
             )
+            if EVENTS.enabled:
+                EVENTS.emit("ladder_split", batch=batch.max_len,
+                            depth=len(batch.docs), phase=phase)
             sub_rows = self._split_rows(batch.batch_size)
             mid = (len(batch.docs) + 1) // 2
             for part in (batch.docs[:mid], batch.docs[mid:]):
@@ -1755,6 +1766,8 @@ class CompiledPipeline:
             TRACER.instant(
                 "ladder_host", {"bucket": batch.max_len, "phase": phase}
             )
+            if EVENTS.enabled:
+                EVENTS.emit("ladder_host", batch=batch.max_len, phase=phase)
             self._breaker.record_failure("device batch fell to host rung")
         else:
             self._breaker.record_success()

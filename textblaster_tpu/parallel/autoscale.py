@@ -25,6 +25,7 @@ import subprocess
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..errors import PipelineError
+from ..utils.events import EVENTS
 from ..utils.metrics import METRICS
 from ..utils.trace import TRACER
 
@@ -147,6 +148,8 @@ class AutoscaleSupervisor:
             {"joiner": jid, "backlog_rows": backlog,
              "live": list(live)},
         )
+        if EVENTS.enabled:
+            EVENTS.emit("autoscale_spawn", rank=jid, backlog_rows=backlog)
         self.say(
             f"autoscale: spawned joiner rank {jid} "
             f"(pid {getattr(proc, 'pid', '?')}) — backlog {backlog} "

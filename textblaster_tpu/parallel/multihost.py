@@ -93,6 +93,7 @@ from ..resilience.membership import (
     elect_members,
 )
 from ..resilience.watchdog import WATCHDOG
+from ..utils.events import EVENTS
 from ..utils.trace import TRACER
 from .mesh import DATA_AXIS, batch_sharding
 
@@ -419,6 +420,9 @@ def _raise_peer_failure(
         {"seq": seq, "epoch": epoch, "missing": list(missing),
          "dead": list(dead)},
     )
+    if EVENTS.enabled:
+        EVENTS.emit("peer_failure", missing_ranks=list(missing),
+                    dead_ranks=list(dead), seq=seq, epoch=epoch)
     detail = (
         f"; liveness leases mark rank(s) {list(dead)} dead "
         f"(lease older than {store.ttl_s:g}s)"
@@ -740,6 +744,14 @@ class FileLeaseTransport(ExchangeTransport):
             {"epoch": epoch, "seq": seq, "missing": list(missing),
              "dead": list(dead)},
         )
+        if EVENTS.enabled:
+            # The detection is a peer failure whether or not the gang
+            # survives it; the journal names it first so the causal chain
+            # reads peer_failure -> gang_reform_start -> gang_reformation.
+            EVENTS.emit("peer_failure", missing_ranks=list(missing),
+                        dead_ranks=list(dead), epoch=epoch, seq=seq)
+            EVENTS.emit("gang_reform_start", epoch=epoch, seq=seq,
+                        missing=list(missing), dead=list(dead))
         members, newly_dead = elect_members(
             self.store,
             self._members,
@@ -759,12 +771,19 @@ class FileLeaseTransport(ExchangeTransport):
         )
         METRICS.inc("multihost_gang_reformations_total")
         METRICS.set("multihost_reformation_epoch", float(self.tracker.epoch))
+        if EVENTS.enabled:
+            # Records emitted from here on carry the new gang generation.
+            EVENTS.set_incarnation(self.reformations)
         TRACER.instant(
             "gang_reformation",
             {"membership_epoch": self.tracker.epoch,
              "exchange_epoch": new_exchange_epoch,
              "members": list(members), "dead": list(newly_dead)},
         )
+        if EVENTS.enabled:
+            EVENTS.emit("gang_reformation", epoch=self.tracker.epoch,
+                        world_size=len(members), members=list(members),
+                        dead=list(newly_dead))
         print(
             f"reform[{self.rank}]: exchange e{epoch}/s{seq} deadline "
             f"({_EXCHANGE.deadline_s:g}s) expired; fenced rank(s) "
@@ -857,6 +876,9 @@ class FileLeaseTransport(ExchangeTransport):
             "gang_admission_start",
             {"exchange_epoch": epoch, "joiners": list(union)},
         )
+        if EVENTS.enabled:
+            EVENTS.emit("gang_admission_start", epoch=epoch,
+                        joiners=list(union))
         members, newly_dead = elect_members(
             self.store,
             self._members,
@@ -900,6 +922,10 @@ class FileLeaseTransport(ExchangeTransport):
              "members": list(members), "admitted": admitted,
              "dead": list(newly_dead)},
         )
+        if EVENTS.enabled:
+            EVENTS.emit("gang_admission", epoch=self.tracker.epoch,
+                        world_size=len(members), admitted=list(admitted),
+                        dead=list(newly_dead))
         print(
             f"admit[{self.rank}]: admitted rank(s) {admitted} at phase "
             f"boundary (exchange epoch {epoch}); members now "
@@ -1186,6 +1212,9 @@ def _negotiate_depth(local_depth: int, local_spec_depth: Optional[int] = None):
             "window_depth_mismatch",
             {"host_depths": [int(d) for d in depths], "joint": joint},
         )
+        if EVENTS.enabled:
+            EVENTS.emit("window_depth_mismatch", joint=joint,
+                        host_depths=[int(d) for d in depths])
     if local_spec_depth is None:
         return joint
     spec = max(0, int(merged[:, 1].min()))
@@ -1656,6 +1685,9 @@ def run_local_shard(
                             {"replayed": 0, "pending": 0, "voided": n,
                              "phase": phase, "cause": "speculation_void"},
                         )
+                        if EVENTS.enabled:
+                            EVENTS.emit("speculation_void", voided=n,
+                                        phase=phase, cause="drain")
 
                 def drain_window():
                     """Joint fault verdict convened at the window front:
@@ -2204,6 +2236,10 @@ def run_local_shard(
                                  "phase": phase,
                                  "cause": "speculation_void"},
                             )
+                            if EVENTS.enabled:
+                                EVENTS.emit("speculation_void", voided=1,
+                                            phase=phase,
+                                            cause="bucket_latch")
                         degraded.extend(chunk)
                         consumed[j] = True
                         continue
@@ -2292,6 +2328,9 @@ def run_local_shard(
                         {"replayed": 0, "pending": 0, "voided": n_void,
                          "phase": phase, "cause": "speculation_void"},
                     )
+                    if EVENTS.enabled:
+                        EVENTS.emit("speculation_void", voided=n_void,
+                                    phase=phase, cause="reformation")
                 spec_inflight = {}
                 for e in spec_next.values():
                     e["out"] = None
@@ -2403,7 +2442,7 @@ def run_multihost(
     pending stripe to it (the donor fences at its next committed chunk,
     the joiner adopts the cursor — dead-stripe adoption in reverse).
     ``run_report`` is supported (the merging rank folds per-rank report
-    shards into the merged v3 report; an aborted run leaves a partial
+    shards into the merged v4 report; an aborted run leaves a partial
     report, like the kv path); ``auto_geometry`` stays incompatible (a
     full-gang collective with no lockstep exchange to ride).
     ``autoscale="MIN:MAX"`` arms the supervisor loop on the lowest live
@@ -2793,12 +2832,10 @@ def run_multihost(
         # Runs on EVERY process or on none — see the docstring contract.
         host_reports = None
         if run_report is not None:
+            from ..utils.metrics import snapshot_delta
+
             now = metrics_snapshot()
-            local_delta = {
-                k: round(now.get(k, 0.0) - values_before.get(k, 0.0), 6)
-                for k in set(now) | set(values_before)
-                if now.get(k, 0.0) != values_before.get(k, 0.0)
-            }
+            local_delta = snapshot_delta(values_before, now)
             host_reports = host_allgather_obj(
                 {
                     "process": process_id,
@@ -2833,7 +2870,7 @@ def run_multihost(
             )
             merged.errors, merged.read_errors = int(g[3]), int(g[4])
             if host_reports is not None:
-                from ..utils.metrics import _SPECS
+                from ..utils.metrics import is_merge_gauge
 
                 summed: dict = {}
                 for h in host_reports:
@@ -2841,7 +2878,7 @@ def run_multihost(
                         # Counters sum across hosts; gauges (gang-agreed
                         # values like the negotiated window depth) merge
                         # by max so the report shows the value, not n x it.
-                        if _SPECS.get(k, ("counter",))[0] == "gauge":
+                        if is_merge_gauge(k):
                             summed[k] = max(summed.get(k, v), v)
                         else:
                             summed[k] = summed.get(k, 0.0) + v
@@ -2941,8 +2978,8 @@ def _finish_file_coordinated(
     from ..resilience import DeadLetterSink
     from ..utils.metrics import (
         METRICS,
-        _SPECS,
         build_run_report,
+        is_merge_gauge,
         metrics_snapshot,
         write_run_report,
     )
@@ -2968,6 +3005,9 @@ def _finish_file_coordinated(
                 "stripe_adopted",
                 {"stripe": r, "epoch": file_transport.tracker.epoch},
             )
+            if EVENTS.enabled:
+                EVENTS.emit("stripe_adopted", stripe=r, adopter=process_id,
+                            epoch=file_transport.tracker.epoch)
             print(
                 f"reform[{process_id}]: adopting dead rank {r}'s stripe "
                 f"({take_r} row(s))",
@@ -3081,12 +3121,10 @@ def _finish_file_coordinated(
             all_totals = host_allgather(totals).reshape(-1, 5)
 
             if run_report is not None:
+                from ..utils.metrics import snapshot_delta
+
                 now = metrics_snapshot()
-                local_delta = {
-                    k: round(now.get(k, 0.0) - values_before.get(k, 0.0), 6)
-                    for k in set(now) | set(values_before)
-                    if now.get(k, 0.0) != values_before.get(k, 0.0)
-                }
+                local_delta = snapshot_delta(values_before, now)
                 host_reports = host_allgather_obj(
                     {
                         "process": process_id,
@@ -3136,7 +3174,7 @@ def _finish_file_coordinated(
             for k, v in h["metrics"].items():
                 # Counters sum across hosts; gauges merge by max (same
                 # rule as the kv-path report).
-                if _SPECS.get(k, ("counter",))[0] == "gauge":
+                if is_merge_gauge(k):
                     summed[k] = max(summed.get(k, v), v)
                 else:
                     summed[k] = summed.get(k, 0.0) + v
@@ -3294,8 +3332,8 @@ def _run_elastic(
     )
     from ..utils.metrics import (
         METRICS,
-        _SPECS,
         build_run_report,
+        is_merge_gauge,
         metrics_snapshot,
         write_run_report,
     )
@@ -3558,6 +3596,10 @@ def _run_elastic(
                             "stripe_adopted",
                             {"stripe": s, "epoch": tracker.epoch},
                         )
+                        if EVENTS.enabled:
+                            EVENTS.emit("stripe_adopted", stripe=s,
+                                        adopter=process_id,
+                                        epoch=tracker.epoch)
                         say(
                             f"adopted stripe {s} at row {st.rows_consumed}"
                             f"/{take} (epoch {tracker.epoch})"
@@ -3663,12 +3705,10 @@ def _run_elastic(
         # report (this rank's contribution, flagged) — the same contract
         # the kv path keeps on a PeerFailure abort.
         if run_report is not None and not isinstance(exc, GeneratorExit):
+            from ..utils.metrics import snapshot_delta
+
             now = metrics_snapshot()
-            delta = {
-                k: round(now.get(k, 0.0) - values_before.get(k, 0.0), 6)
-                for k in set(now) | set(values_before)
-                if now.get(k, 0.0) != values_before.get(k, 0.0)
-            }
+            delta = snapshot_delta(values_before, now)
             partial = build_run_report(
                 values=delta,
                 wall_time_s=round(time.perf_counter() - wall_t0, 3),
@@ -3697,12 +3737,10 @@ def _run_elastic(
         # rank folds whatever shards the (possibly churned) membership
         # left behind — counts stay exact either way, they come from the
         # stripe cursors.
+        from ..utils.metrics import snapshot_delta
+
         now = metrics_snapshot()
-        delta = {
-            k: round(now.get(k, 0.0) - values_before.get(k, 0.0), 6)
-            for k in set(now) | set(values_before)
-            if now.get(k, 0.0) != values_before.get(k, 0.0)
-        }
+        delta = snapshot_delta(values_before, now)
         os.makedirs(report_dir, exist_ok=True)
         path = os.path.join(report_dir, f"rank{process_id}.json")
         tmp = f"{path}.tmp.{store.incarnation}"
@@ -3806,7 +3844,7 @@ def _run_elastic(
                 # Same merge rule as the coordinated path: counters sum
                 # across ranks, gauges (gang-agreed values like the
                 # membership epoch) merge by max.
-                if _SPECS.get(k, ("counter",))[0] == "gauge":
+                if is_merge_gauge(k):
                     summed[k] = max(summed.get(k, v), v)
                 else:
                     summed[k] = summed.get(k, 0.0) + v

@@ -25,6 +25,7 @@ import logging
 import threading
 import time
 
+from ..utils.events import EVENTS
 from ..utils.metrics import METRICS
 from ..utils.trace import TRACER
 
@@ -96,6 +97,8 @@ class CircuitBreaker:
             self._state = _HALF_OPEN
         METRICS.inc("resilience_breaker_probe_total")
         TRACER.instant("breaker_probe", {"breaker": self.name})
+        if EVENTS.enabled:
+            EVENTS.emit("breaker_probe", seam=self.name)
         logger.warning(
             "Circuit breaker '%s' half-open after %.1fs cooldown; probing "
             "the device with one batch.",
@@ -112,6 +115,8 @@ class CircuitBreaker:
             self._state = _CLOSED
         METRICS.inc("resilience_breaker_recoveries_total")
         TRACER.instant("breaker_recovery", {"breaker": self.name})
+        if EVENTS.enabled:
+            EVENTS.emit("breaker_recovery", seam=self.name)
         METRICS.set("resilience_breaker_open", 0)
         logger.warning(
             "Circuit breaker '%s' closed: half-open probe succeeded; "
@@ -138,6 +143,8 @@ class CircuitBreaker:
         if reopened:
             METRICS.set("resilience_breaker_open", 1)
             TRACER.instant("breaker_reopen", {"breaker": self.name})
+            if EVENTS.enabled:
+                EVENTS.emit("breaker_reopen", seam=self.name, cause=cause)
             logger.error(
                 "Circuit breaker '%s' reopened: half-open probe failed%s; "
                 "cooling down for %.1fs.",
@@ -149,6 +156,9 @@ class CircuitBreaker:
         METRICS.inc("resilience_breaker_trips_total")
         TRACER.instant("breaker_trip",
                        {"breaker": self.name, "cause": cause})
+        if EVENTS.enabled:
+            EVENTS.emit("breaker_trip", seam=self.name,
+                        failures=self.threshold, cause=cause)
         METRICS.set("resilience_breaker_open", 1)
         logger.error(
             "Circuit breaker '%s' tripped after %d consecutive failures%s; "

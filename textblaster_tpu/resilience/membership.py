@@ -53,6 +53,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..errors import PeerFailure, PipelineError, ReformationFailed
+from ..utils.events import EVENTS
 from ..utils.metrics import METRICS
 from ..utils.trace import TRACER
 from .faults import FAULTS
@@ -427,6 +428,9 @@ class FileMembershipStore:
             "rank_fenced",
             {"rank": int(rank), "incarnation": inc, "by": self.rank},
         )
+        if EVENTS.enabled:
+            EVENTS.emit("rank_fenced", rank=int(rank), incarnation=inc,
+                        by=self.rank)
         return inc, True
 
     def fenced_ranks(self) -> List[int]:
@@ -554,6 +558,9 @@ class FileMembershipStore:
             "join_request",
             {"rank": self.rank, "incarnation": self.incarnation},
         )
+        if EVENTS.enabled:
+            EVENTS.emit("join_request", rank=self.rank,
+                        incarnation=self.incarnation)
 
     def read_join_requests(
         self, now: Optional[float] = None
@@ -927,6 +934,8 @@ class EpochTracker:
             TRACER.instant(
                 "membership_evict", {"rank": r, "epoch": self.epoch}
             )
+            if EVENTS.enabled:
+                EVENTS.emit("membership_evict", rank=r, epoch=self.epoch)
             events.append(f"evicted rank {r} (lease expired); epoch {self.epoch}")
         for r in sorted(appeared):
             if r in self.ever:
@@ -934,6 +943,9 @@ class EpochTracker:
                 TRACER.instant(
                     "membership_rejoin", {"rank": r, "epoch": self.epoch}
                 )
+                if EVENTS.enabled:
+                    EVENTS.emit("membership_rejoin", rank=r,
+                                epoch=self.epoch)
                 events.append(f"rank {r} rejoined; epoch {self.epoch}")
             else:
                 if prev_min == self.rank:
@@ -941,6 +953,8 @@ class EpochTracker:
                 TRACER.instant(
                     "membership_join", {"rank": r, "epoch": self.epoch}
                 )
+                if EVENTS.enabled:
+                    EVENTS.emit("membership_join", rank=r, epoch=self.epoch)
                 events.append(
                     f"rank {r} joined the gang; epoch {self.epoch}"
                 )

@@ -53,6 +53,7 @@ from typing import Callable, Dict, Optional, Sequence
 
 import numpy as np
 
+from ..utils.events import EVENTS
 from ..utils.metrics import METRICS
 from ..utils.trace import TRACER
 from .breaker import CircuitBreaker
@@ -301,6 +302,9 @@ class NegotiatedGuard:
                         {"bucket": bucket, "attempt": attempt,
                          "epoch": self._epoch()},
                     )
+                    if EVENTS.enabled:
+                        EVENTS.emit("negotiated_reformed", bucket=bucket,
+                                    attempt=attempt)
                     raise
             if not any_fault:
                 self.breakers[bucket].record_success()
@@ -310,6 +314,9 @@ class NegotiatedGuard:
                 {"bucket": bucket, "local_fault": local_fault,
                  "attempt": attempt, "epoch": self._epoch()},
             )
+            if EVENTS.enabled:
+                EVENTS.emit("negotiated_verdict", bucket=bucket,
+                            local_fault=bool(local_fault), attempt=attempt)
             if on_fault is not None:
                 on_fault()
                 on_fault = None
@@ -319,6 +326,8 @@ class NegotiatedGuard:
                     "negotiated_degraded",
                     {"bucket": bucket, "epoch": self._epoch()},
                 )
+                if EVENTS.enabled:
+                    EVENTS.emit("negotiated_degraded", bucket=bucket)
                 self.breakers[bucket].record_failure(
                     "negotiated round retries exhausted"
                 )
@@ -337,6 +346,9 @@ class NegotiatedGuard:
                 {"bucket": bucket, "attempt": attempt, "backoff_s": delay,
                  "epoch": self._epoch()},
             )
+            if EVENTS.enabled:
+                EVENTS.emit("negotiated_retry", bucket=bucket,
+                            attempt=attempt)
             logger.warning(
                 "Negotiated retry %d/%d of lockstep round (bucket %s) on "
                 "all hosts, shared backoff %.3fs.",

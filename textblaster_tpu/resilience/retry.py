@@ -28,6 +28,7 @@ from ..errors import (
     StallError,
     StepError,
 )
+from ..utils.events import EVENTS
 from ..utils.metrics import METRICS
 from ..utils.trace import TRACER
 from .watchdog import WATCHDOG
@@ -192,6 +193,10 @@ class RetryPolicy:
                 WATCHDOG.escalated(e)
                 if attempt >= self.max_retries:
                     METRICS.inc("resilience_retry_exhausted_total")
+                    if EVENTS.enabled:
+                        EVENTS.emit("retry_exhausted", seam=seam,
+                                    attempts=attempt + 1,
+                                    error=type(e).__name__)
                     raise RetryExhaustedError(seam, attempt + 1, e) from e
                 delay = self.delay_for(attempt)
                 attempt += 1
@@ -201,6 +206,9 @@ class RetryPolicy:
                     "retry", {"seam": seam, "attempt": attempt,
                               "error": type(e).__name__}
                 )
+                if EVENTS.enabled:
+                    EVENTS.emit("retry", seam=seam, attempt=attempt,
+                                error=type(e).__name__)
                 logger.warning(
                     "Transient fault at seam '%s' (attempt %d/%d, backing off "
                     "%.3fs): %s",

@@ -38,6 +38,7 @@ from contextlib import contextmanager
 from typing import Callable, Dict, Iterable, Iterator, Mapping, Optional
 
 from ..errors import StallError
+from ..utils.events import EVENTS
 from ..utils.metrics import METRICS
 from ..utils.trace import TRACER
 
@@ -150,6 +151,10 @@ class StageWatchdog:
                 "detail": detail,
             },
         )
+        if EVENTS.enabled:
+            EVENTS.emit("watchdog_stall", stage=stage,
+                        elapsed_s=round(elapsed_s, 3),
+                        deadline_s=deadline_s, detail=detail)
         raise StallError(
             stage, elapsed_s=elapsed_s, deadline_s=deadline_s, detail=detail
         )
@@ -161,6 +166,8 @@ class StageWatchdog:
         if isinstance(exc, StallError):
             METRICS.inc("watchdog_escalations_total")
             TRACER.instant("watchdog_escalation", {"stage": exc.stage})
+            if EVENTS.enabled:
+                EVENTS.emit("watchdog_escalation", reason=exc.stage)
 
     # -- heartbeats (fault-injector integration) ---------------------------
 

@@ -21,7 +21,12 @@ __all__ = ["init_logging"]
 class _JsonFormatter(logging.Formatter):
     def format(self, record: logging.LogRecord) -> str:
         payload = {
-            "timestamp": datetime.now(timezone.utc).isoformat(),
+            # record.created, not now(): format time lags emit time whenever
+            # the handler queue backs up, and post-mortem ordering depends
+            # on the emit-time stamp.
+            "timestamp": datetime.fromtimestamp(
+                record.created, timezone.utc
+            ).isoformat(),
             "level": record.levelname,
             "target": record.name,
             "message": record.getMessage(),
@@ -50,6 +55,14 @@ def init_logging(name: str, log_dir: str = "./log") -> None:
         logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
     )
     root.addHandler(console)
+
+    # WARNING+ records mirror into the operational event journal (kind
+    # "log") once EVENTS is armed; the handler self-gates on
+    # EVENTS.enabled, so an unarmed run pays one attribute check per
+    # warning — not per log call.
+    from .events import JournalLogHandler
+
+    root.addHandler(JournalLogHandler())
 
     try:
         os.makedirs(log_dir, exist_ok=True)

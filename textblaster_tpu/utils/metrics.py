@@ -35,6 +35,13 @@ __all__ = [
     "FILTER_DROP_PREFIX",
     "DEVICE_TIME_PREFIX",
     "DEVICE_BPS_PREFIX",
+    "EVENT_KIND_PREFIX",
+    "SLO_EVENTS_PREFIX",
+    "SLO_BAD_EVENTS_PREFIX",
+    "SLO_GAUGE_PREFIXES",
+    "is_merge_gauge",
+    "snapshot_delta",
+    "events_report",
     "funnel_snapshot",
     "funnel_report",
     "format_funnel_summary",
@@ -552,6 +559,35 @@ _SPECS: Dict[str, Tuple[str, str]] = {
         "Trace events dropped: ring overflow with no spill file, or a "
         "spill write that failed (disk full / unwritable path)",
     ),
+    # Operational event journal (utils/events.py): severity-leveled JSONL
+    # record of every resilience/membership/watchdog/SLO transition.
+    "events_emitted_total": (
+        "counter",
+        "Operational events recorded by the journal (per-kind counts in "
+        "the dynamic events_total_<kind> families)",
+    ),
+    "events_dropped_total": (
+        "counter",
+        "Journal events dropped: ring overflow with no spill file, or a "
+        "spill write that failed (disk full / unwritable path)",
+    ),
+    "events_invalid_total": (
+        "counter",
+        "Journal emit() calls rejected for schema violations (unknown "
+        "kind or missing required data fields)",
+    ),
+    # SLO engine (utils/slo.py): burn-rate alerting over declared
+    # objectives; per-objective state lives in the dynamic slo_* families.
+    "slo_alerts_total": (
+        "counter",
+        "Edge-triggered SLO alerts: both the fast and slow burn-rate "
+        "windows exceeded the threshold for an objective",
+    ),
+    "pipeline_warmup_done": (
+        "gauge",
+        "1 once the warmup decision has resolved for this process (warmed "
+        "or deliberately skipped) — the /healthz readiness gate",
+    ),
     # Device-occupancy accounting (ops/pipeline.py record_occupancy): a
     # compiled program computes every padded lane of its fixed shape, so
     # real/padded is the fraction of device work spent on actual text.
@@ -594,6 +630,33 @@ DEVICE_TIME_PREFIX = "device_time_bucket_"
 #: <P>``): the program's modeled bytes accessed divided by the latest
 #: dispatch's blocked-on-device seconds.
 DEVICE_BPS_PREFIX = "device_achieved_bytes_per_s_bucket_"
+
+#: Per-kind journal counters are dynamic — one counter per event kind
+#: actually emitted (``events_total_<kind>``, fed by
+#: ``utils.events.EVENTS.emit``); counters, so the multihost sum-merge
+#: aggregates gang-wide event counts and run-report v4 reads them from
+#: any flat snapshot.
+EVENT_KIND_PREFIX = "events_total_"
+
+#: Per-objective SLO families are dynamic too (one member per declared
+#: ``--slo`` key): monotone event/bad-event counters plus the target /
+#: burn-rate / budget-remaining gauges published by ``utils.slo.SLO``.
+SLO_EVENTS_PREFIX = "slo_events_total_"
+SLO_BAD_EVENTS_PREFIX = "slo_bad_events_total_"
+SLO_GAUGE_PREFIXES = (
+    "slo_target_", "slo_burn_rate_", "slo_budget_remaining_",
+)
+
+
+def is_merge_gauge(name: str) -> bool:
+    """True when a flat-snapshot key must merge by max (a gauge), not by
+    sum.  The multihost merge used to consult ``_SPECS`` alone, which
+    silently summed *dynamic* gauges; every dynamic gauge family prefix
+    is enumerated here so new ones can't regress the merge."""
+    spec = _SPECS.get(name)
+    if spec is not None:
+        return spec[0] == "gauge"
+    return name.startswith(SLO_GAUGE_PREFIXES)
 
 
 def _dynamic_hdr_help(name: str) -> str:
@@ -816,6 +879,16 @@ def metrics_snapshot() -> Dict[str, float]:
     the cross-host sum-merge aggregates histograms bucket-wise exactly like
     counters (the keys can't collide with real metric names — '::' never
     appears in one)."""
+    # Flush the SLO engine first (when armed): its counters are published
+    # on evaluation ticks, and a run shorter than one tick would otherwise
+    # hand the report/exchange a snapshot with stale zeros.
+    try:
+        from .slo import SLO
+
+        if SLO.enabled:
+            SLO.evaluate()
+    except Exception:  # noqa: BLE001 — snapshot must not fail on a tick
+        pass
     return METRICS.all_values()
 
 
@@ -947,8 +1020,76 @@ def histogram_report(
 #: the ``latency`` (per-stage HDR quantile blocks) and ``histograms``
 #: (fixed-bucket histogram deltas) sections; v3 adds ``device_profile``
 #: (static cost model, per-(bucket, phase) device-time quantiles, roofline
-#: gauges, top-K dispatches, lockstep decomposition).
-RUN_REPORT_SCHEMA = "textblaster-run-report/v3"
+#: gauges, top-K dispatches, lockstep decomposition); v4 adds ``events``
+#: (per-kind operational journal counts + drop/invalid accounting) and
+#: ``slo`` (per-objective burn-rate / error-budget state).
+RUN_REPORT_SCHEMA = "textblaster-run-report/v4"
+
+
+def events_report(
+    baseline: Optional[Dict[str, float]] = None,
+    values: Optional[Dict[str, float]] = None,
+) -> Dict[str, object]:
+    """The run report's ``events`` section: per-kind journal counts as int
+    deltas, plus the emitted/dropped/invalid totals.  Pure counter reads,
+    so the section built from a gang-merged snapshot carries the summed
+    gang-wide event counts by construction."""
+    base = baseline or {}
+    delta = _delta_fn(baseline, values)
+    per_kind: Dict[str, int] = {}
+    for name, value in _prefixed_from(values, EVENT_KIND_PREFIX).items():
+        d = value - base.get(name, 0.0)
+        if d > 0:
+            per_kind[name[len(EVENT_KIND_PREFIX):]] = int(d)
+    emitted = int(delta("events_emitted_total"))
+    if not per_kind and emitted == 0:
+        return {}
+    return {
+        "emitted_total": emitted,
+        "dropped_total": int(delta("events_dropped_total")),
+        "invalid_total": int(delta("events_invalid_total")),
+        "by_kind": dict(
+            sorted(per_kind.items(), key=lambda kv: (-kv[1], kv[0]))
+        ),
+    }
+
+
+def _slo_section(
+    baseline: Optional[Dict[str, float]] = None,
+    values: Optional[Dict[str, float]] = None,
+) -> Dict[str, object]:
+    """The ``slo`` report section, built by utils/slo.py.  Imported lazily
+    (slo.py imports this module at runtime; the reverse edge only exists
+    inside a report build) and never allowed to fail the report."""
+    try:
+        from .slo import slo_report
+
+        return slo_report(baseline, values)
+    except Exception as e:  # noqa: BLE001 — observability must not kill a run
+        logger.warning("slo section skipped: %s", e)
+        return {}
+
+
+def snapshot_delta(
+    before: Dict[str, float], now: Dict[str, float]
+) -> Dict[str, float]:
+    """A run-scoped metrics snapshot for report shards: counters as
+    ``now - before``, merge-gauges (:func:`is_merge_gauge`) at their
+    *current* value.  A gauge armed before the run window opened — the
+    ``slo_target_*`` triple, watchdog deadlines — deltas to zero and
+    would silently vanish from the merged report otherwise; the max-merge
+    the gang applies downstream wants the level, not the movement."""
+    out: Dict[str, float] = {}
+    for k in set(now) | set(before):
+        if is_merge_gauge(k):
+            v = round(now.get(k, 0.0), 6)
+            if v != 0.0:
+                out[k] = v
+        else:
+            d = round(now.get(k, 0.0) - before.get(k, 0.0), 6)
+            if d != 0.0:
+                out[k] = d
+    return out
 
 
 def _device_profile_section(
@@ -994,6 +1135,8 @@ def build_run_report(
         "resilience": resilience_report(baseline, values),
         "funnel": funnel_report(baseline, values),
         "device_profile": _device_profile_section(baseline, values),
+        "events": events_report(baseline, values),
+        "slo": _slo_section(baseline, values),
         "config": dict(provenance or {}),
     }
     if hosts is not None:
@@ -1041,6 +1184,32 @@ def metrics_catalog_markdown() -> str:
         f"| `{DEVICE_BPS_PREFIX}<L>_phase_<P>` | gauge | Dynamic family: "
         "achieved device bytes/s (modeled bytes accessed / last dispatch "
         "wait) at bucket length `<L>`, phase `<P>` |"
+    )
+    lines.append(
+        f"| `{EVENT_KIND_PREFIX}<kind>` | counter | Dynamic family: "
+        "operational journal events of kind `<kind>` (enumerated in "
+        "`utils.events.KINDS`) |"
+    )
+    lines.append(
+        f"| `{SLO_EVENTS_PREFIX}<key>` | counter | Dynamic family: SLO "
+        "events evaluated for objective `<key>` |"
+    )
+    lines.append(
+        f"| `{SLO_BAD_EVENTS_PREFIX}<key>` | counter | Dynamic family: "
+        "SLO budget-consuming (bad) events for objective `<key>` |"
+    )
+    lines.append(
+        "| `slo_target_<key>` | gauge | Dynamic family: declared SLO "
+        "target for objective `<key>` |"
+    )
+    lines.append(
+        "| `slo_burn_rate_<key>` | gauge | Dynamic family: fast-window "
+        "error-budget burn rate for objective `<key>` (1.0 = consuming "
+        "exactly the budget) |"
+    )
+    lines.append(
+        "| `slo_budget_remaining_<key>` | gauge | Dynamic family: "
+        "fraction of the error budget left for objective `<key>` |"
     )
     return "\n".join(lines)
 
@@ -1233,6 +1402,43 @@ class Metrics:
                 )
                 lines.append(f"# TYPE {name} gauge")
                 lines.append(f"{name} {self._values[name]:g}")
+            for name in sorted(
+                k for k in self._values if k.startswith(EVENT_KIND_PREFIX)
+            ):
+                lines.append(
+                    f"# HELP {name} Operational journal events of kind "
+                    f"{name[len(EVENT_KIND_PREFIX):]}"
+                )
+                lines.append(f"# TYPE {name} counter")
+                lines.append(f"{name} {self._values[name]:g}")
+            # SLO dynamic families: events/bad-events counters, then the
+            # target / burn-rate / budget-remaining gauges.  slo_events_
+            # is a prefix of slo_events_total_ members only, so the two
+            # counter loops can't overlap the gauge loop.
+            for prefix, help_fmt in (
+                (SLO_EVENTS_PREFIX, "SLO events evaluated for objective "),
+                (SLO_BAD_EVENTS_PREFIX, "SLO budget-consuming events for objective "),
+            ):
+                for name in sorted(
+                    k for k in self._values if k.startswith(prefix)
+                ):
+                    lines.append(f"# HELP {name} {help_fmt}{name[len(prefix):]}")
+                    lines.append(f"# TYPE {name} counter")
+                    lines.append(f"{name} {self._values[name]:g}")
+            for prefix, help_fmt in (
+                ("slo_target_", "Declared SLO target for objective "),
+                ("slo_burn_rate_", "Fast-window error-budget burn rate for objective "),
+                (
+                    "slo_budget_remaining_",
+                    "Fraction of the error budget left for objective ",
+                ),
+            ):
+                for name in sorted(
+                    k for k in self._values if k.startswith(prefix)
+                ):
+                    lines.append(f"# HELP {name} {help_fmt}{name[len(prefix):]}")
+                    lines.append(f"# TYPE {name} gauge")
+                    lines.append(f"{name} {self._values[name]:g}")
             return "\n".join(lines) + "\n"
 
 
@@ -1248,6 +1454,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _respond(self, send_body: bool) -> None:
         path = self.path.split("?", 1)[0]
+        status = 200
         if self._is_metrics_path():
             body = METRICS.render().encode("utf-8")
             ctype = "text/plain; version=0.0.4"
@@ -1261,11 +1468,27 @@ class _Handler(BaseHTTPRequestHandler):
                 json.dumps(TELEMETRY.snapshot(), sort_keys=True) + "\n"
             ).encode("utf-8")
             ctype = "application/json"
+        elif path == "/healthz":
+            # Live/ready verdict (200 ready, 503 starting/degraded) with a
+            # component breakdown.  Lazy import for the same reason.
+            from .slo import health_snapshot
+
+            status, health = health_snapshot()
+            body = (json.dumps(health, sort_keys=True) + "\n").encode("utf-8")
+            ctype = "application/json"
+        elif path == "/slo":
+            # Live SLO engine state (objectives, burn rates, alerts).
+            from .slo import SLO
+
+            body = (
+                json.dumps(SLO.snapshot(), sort_keys=True) + "\n"
+            ).encode("utf-8")
+            ctype = "application/json"
         else:
             self.send_response(404)
             self.end_headers()
             return
-        self.send_response(200)
+        self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()

@@ -641,6 +641,8 @@ _SCHEDULING_ENV_KNOBS = (
     "TEXTBLAST_SPECULATE",
     "TEXTBLAST_NO_OVERLAP",
     "TEXTBLAST_STAGE_DEADLINE_S",
+    "TEXTBLAST_EVENTS",
+    "TEXTBLAST_SLO",
 )
 
 

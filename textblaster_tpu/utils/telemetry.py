@@ -391,6 +391,14 @@ class Telemetry:
                 if deviation > self._drift_threshold:
                     if not self._drift_high:  # edge-trigger the instant
                         self._drift_high = True
+                        from .events import EVENTS
+
+                        if EVENTS.enabled:
+                            EVENTS.emit(
+                                "geometry_drift", ratio=deviation,
+                                live_waste=waste,
+                                baseline_waste=self._baseline_waste,
+                            )
                         TRACER.instant(
                             "geometry_drift",
                             {

@@ -270,6 +270,18 @@ class Tracer:
             }
         )
 
+    def now_us(self) -> int:
+        """Current time in microseconds on this trace's clock.
+
+        With tracing configured, the value is a ``perf_counter`` delta from
+        ``configure()`` shifted by the multihost :meth:`align` offset — the
+        same clock every span and instant is stamped with, so consumers
+        (the event journal) interleave correctly with the trace.  With
+        tracing off, ``_t0`` is 0 and the value degrades to raw
+        ``perf_counter`` microseconds: still monotone within the process,
+        just not cross-host aligned."""
+        return self._now_us()
+
     # --- internals ----------------------------------------------------------
 
     def _now_us(self) -> int:
